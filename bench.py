@@ -1,0 +1,54 @@
+"""Benchmark entry point. Prints ONE JSON line:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Current flagship metric: SSB-Q1.1-shaped filtered-sum p50 latency on the
+available device. vs_baseline is target_ms / measured_ms against the
+driver's 500 ms/query north-star target (BASELINE.json:2) — >1.0 beats it.
+This will widen to the full SSB 13-query suite as the engine lands.
+"""
+
+import json
+import time
+
+import numpy as np
+
+TARGET_MS = 500.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    n = 4_000_000
+    rng = np.random.default_rng(0)
+    price = jnp.asarray(rng.integers(100, 10_000_000, n, dtype=np.int32))
+    discount = jnp.asarray(rng.integers(0, 11, n, dtype=np.int32))
+    quantity = jnp.asarray(rng.integers(1, 51, n, dtype=np.int32))
+    year = jnp.asarray(rng.integers(1992, 1999, n, dtype=np.int32))
+
+    @jax.jit
+    def q11(price, discount, quantity, year):
+        mask = ((year == 1993) & (discount >= 1) & (discount <= 3)
+                & (quantity < 25))
+        # float32 on purpose: this placeholder measures scan+reduce latency
+        # only; parity-grade (wide-accumulator) summation lives in the engine
+        rev = price.astype(jnp.float32) * discount.astype(jnp.float32)
+        return jnp.sum(jnp.where(mask, rev, 0.0))
+
+    q11(price, discount, quantity, year).block_until_ready()  # compile
+    times = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        q11(price, discount, quantity, year).block_until_ready()
+        times.append((time.perf_counter() - t0) * 1000)
+    p50 = float(np.percentile(times, 50))
+    print(json.dumps({
+        "metric": "ssb_q1.1_shaped_filtered_sum_p50",
+        "value": round(p50, 3),
+        "unit": "ms",
+        "vs_baseline": round(TARGET_MS / p50, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,177 @@
+"""IR JSON round-trip golden tests (analog of the reference's spec-class
+serialization coverage, SURVEY.md §3.3/§3.6)."""
+
+import json
+
+import pytest
+
+from tpu_olap import ir
+from tpu_olap.ir import (
+    AllGranularity, AndFilter, ArithmeticPostAgg, BoundFilter,
+    CardinalityAggregation, Col, ConstantPostAgg, CountAggregation,
+    DefaultDimensionSpec, DurationGranularity, ExpressionFilter,
+    ExtractionDimensionSpec, FieldAccessPostAgg, FilteredAggregation,
+    GreaterThanHaving, GroupByQuerySpec, HyperUniqueAggregation,
+    HyperUniqueCardinalityPostAgg, InFilter, Interval, LikeFilter, LimitSpec,
+    Lit, MaxAggregation, MinAggregation, NotFilter, OrFilter,
+    PeriodGranularity, RegexFilter, ScanQuerySpec, SearchQueryContains,
+    SearchQuerySpec, SegmentMetadataQuerySpec, SelectorFilter,
+    SumAggregation, ThetaSketchAggregation, TimeBoundaryQuerySpec,
+    TimeFormatExtractionFn, TimeseriesQuerySpec, TopNQuerySpec,
+    VirtualColumn, parse_expr,
+)
+from tpu_olap.ir.limit import OrderByColumnSpec
+from tpu_olap.ir.having import AndHaving, LessThanHaving
+from tpu_olap.ir.serde import query_from_json
+
+
+def roundtrip(q):
+    j = q.to_json()
+    # must be plain-JSON serializable
+    s = json.dumps(j)
+    q2 = query_from_json(json.loads(s))
+    assert q2 == q, f"\n{q}\n!=\n{q2}"
+    return j
+
+
+def test_timeseries_roundtrip():
+    q = TimeseriesQuerySpec(
+        data_source="lineorder",
+        intervals=(Interval.of("1993-01-01", "1994-01-01"),),
+        filter=AndFilter((
+            BoundFilter("lo_discount", lower=1, upper=3, ordering="numeric"),
+            BoundFilter("lo_quantity", upper=25, upper_strict=True,
+                        ordering="numeric"),
+        )),
+        virtual_columns=(VirtualColumn("rev", parse_expr(
+            "lo_extendedprice * lo_discount"), "long"),),
+        granularity=AllGranularity(),
+        aggregations=(SumAggregation("revenue", "rev", "long"),),
+    )
+    j = roundtrip(q)
+    assert j["queryType"] == "timeseries"
+    assert j["aggregations"][0]["type"] == "longSum"
+    assert j["intervals"] == ["1993-01-01T00:00:00.000Z/1994-01-01T00:00:00.000Z"]
+
+
+def test_groupby_roundtrip():
+    q = GroupByQuerySpec(
+        data_source="lineorder",
+        intervals=(Interval.of("1992-01-01", "1999-01-01"),),
+        dimensions=(
+            DefaultDimensionSpec("d_year", "year"),
+            ExtractionDimensionSpec("__time", TimeFormatExtractionFn("YYYY"),
+                                    "ts_year"),
+        ),
+        granularity=PeriodGranularity("P1M", "America/New_York"),
+        aggregations=(
+            CountAggregation("cnt"),
+            SumAggregation("rev", "lo_revenue", "long"),
+            MinAggregation("mn", "lo_discount", "long"),
+            MaxAggregation("mx", "lo_discount", "double"),
+            FilteredAggregation(SelectorFilter("lo_shipmode", "AIR"),
+                                SumAggregation("air_rev", "lo_revenue", "long")),
+            CardinalityAggregation("uniq", ("lo_custkey",)),
+            HyperUniqueAggregation("hu", "lo_partkey"),
+            ThetaSketchAggregation("theta", "lo_suppkey", 4096),
+        ),
+        post_aggregations=(
+            ArithmeticPostAgg("avg_rev", "/", (
+                FieldAccessPostAgg("rev"), FieldAccessPostAgg("cnt"))),
+            ArithmeticPostAgg("x2", "*", (
+                FieldAccessPostAgg("rev"), ConstantPostAgg(2.0, "two"))),
+            HyperUniqueCardinalityPostAgg("hu", "hu_card"),
+        ),
+        having=AndHaving((GreaterThanHaving("rev", 100.0),
+                          LessThanHaving("cnt", 1e9))),
+        limit_spec=LimitSpec(10, (OrderByColumnSpec("rev", "descending"),)),
+    )
+    j = roundtrip(q)
+    assert j["queryType"] == "groupBy"
+    assert j["granularity"] == {"type": "period", "period": "P1M",
+                                "timeZone": "America/New_York"}
+    assert j["limitSpec"]["limit"] == 10
+
+
+def test_topn_roundtrip():
+    q = TopNQuerySpec(
+        data_source="lineorder",
+        dimension=DefaultDimensionSpec("p_brand"),
+        metric="revenue",
+        threshold=10,
+        aggregations=(SumAggregation("revenue", "lo_revenue", "long"),),
+        filter=InFilter("p_category", ("MFGR#12", "MFGR#13")),
+    )
+    j = roundtrip(q)
+    assert j["threshold"] == 10
+
+
+def test_scan_select_search_meta_roundtrip():
+    roundtrip(ScanQuerySpec("t", columns=("a", "b"), limit=100, order="descending"))
+    roundtrip(SearchQuerySpec("t", search_dimensions=("c_name",),
+                              query=SearchQueryContains("smith"), limit=5))
+    roundtrip(SegmentMetadataQuerySpec("t", to_include=("a",)))
+    roundtrip(TimeBoundaryQuerySpec("t", bound="maxTime"))
+
+
+def test_filters_roundtrip():
+    q = ScanQuerySpec(
+        "t",
+        filter=OrFilter((
+            NotFilter(SelectorFilter("a", "x")),
+            RegexFilter("b", "^foo.*"),
+            LikeFilter("c", "%bar_"),
+            ExpressionFilter(parse_expr("m1 + m2 > 10")),
+        )),
+    )
+    roundtrip(q)
+
+
+def test_granularity_simple_strings():
+    from tpu_olap.ir.granularity import granularity_from_json
+    assert granularity_from_json("all") == AllGranularity()
+    g = granularity_from_json("hour")
+    assert g == PeriodGranularity("PT1H")
+    assert granularity_from_json({"type": "duration", "duration": 3600000}) \
+        == DurationGranularity(3600000)
+
+
+def test_druid_json_input_accepted():
+    """A Druid-style query body (queryType, shorthand dims/granularity)."""
+    d = {
+        "queryType": "groupBy",
+        "dataSource": "wikipedia",
+        "granularity": "day",
+        "dimensions": ["page"],
+        "aggregations": [{"type": "longSum", "name": "edits",
+                          "fieldName": "count"}],
+        "intervals": ["2013-01-01T00:00:00.000Z/2013-01-08T00:00:00.000Z"],
+        "filter": {"type": "selector", "dimension": "country", "value": "US"},
+    }
+    q = query_from_json(d)
+    assert isinstance(q, GroupByQuerySpec)
+    assert q.dimensions[0] == DefaultDimensionSpec("page")
+    assert q.granularity == PeriodGranularity("P1D")
+    assert q.filter == SelectorFilter("country", "US")
+
+
+def test_expr_parser():
+    e = parse_expr("a * (b + 2) - c / 4")
+    assert e.columns() == {"a", "b", "c"}
+    assert parse_expr("x") == Col("x")
+    assert parse_expr("3.5") == Lit(3.5)
+    with pytest.raises(ValueError):
+        parse_expr("a +")
+
+
+def test_interval_ops():
+    iv = Interval.parse("1993-01-01T00:00:00Z/1994-01-01T00:00:00Z")
+    assert iv.overlaps(iv.start, iv.start + 1)
+    assert not iv.overlaps(iv.end, iv.end + 1)
+    i2 = iv.intersect(Interval.of("1993-06-01", "1995-01-01"))
+    assert i2 is not None and i2.end == iv.end
+
+
+def test_unknown_type_raises():
+    with pytest.raises(ValueError, match="unknown filter"):
+        ir.from_json("filter", {"type": "bogus"})

@@ -1,0 +1,57 @@
+import datetime as dt
+
+import pytest
+
+from tpu_olap.utils import timeutil as tu
+
+
+def test_period_parse_and_millis():
+    assert tu.period_millis("PT1H") == 3_600_000
+    assert tu.period_millis("P1D") == 86_400_000
+    assert tu.period_millis("P1W") == 7 * 86_400_000
+    assert tu.period_is_uniform("PT15M")
+    assert not tu.period_is_uniform("P1M")
+    assert not tu.period_is_uniform("P1Y")
+    with pytest.raises(ValueError):
+        tu.period_millis("P1M")
+    with pytest.raises(ValueError):
+        tu.parse_period("bogus")
+
+
+def test_iso_roundtrip():
+    ms = tu.parse_iso_datetime("1993-05-17T12:34:56.789Z")
+    assert tu.millis_to_iso(ms) == "1993-05-17T12:34:56.789Z"
+    assert tu.parse_iso_datetime("1993-05-17") == tu.date_to_millis(1993, 5, 17)
+
+
+def test_calendar_boundaries_month():
+    t0 = tu.date_to_millis(1993, 1, 15)
+    t1 = tu.date_to_millis(1993, 4, 2)
+    bs = tu.calendar_boundaries("P1M", "UTC", t0, t1)
+    # floors to Jan 1; covers through Apr, one boundary past t1
+    assert bs[0] == tu.date_to_millis(1993, 1, 1)
+    assert bs[1] == tu.date_to_millis(1993, 2, 1)
+    assert bs[-1] > t1
+    assert len(bs) == 5  # Jan Feb Mar Apr May
+
+
+def test_calendar_boundaries_year_quarter_week():
+    t0 = tu.date_to_millis(1992, 1, 1)
+    t1 = tu.date_to_millis(1994, 12, 31)
+    ys = tu.calendar_boundaries("P1Y", "UTC", t0, t1)
+    assert ys[:3] == [tu.date_to_millis(1992), tu.date_to_millis(1993),
+                      tu.date_to_millis(1994)]
+    qs = tu.calendar_boundaries("P3M", "UTC", t0, tu.date_to_millis(1992, 12, 31))
+    assert qs[1] == tu.date_to_millis(1992, 4, 1)
+    # week floors to Monday: 1993-05-17 is a Monday
+    ws = tu.calendar_boundaries("P1W", "UTC", tu.date_to_millis(1993, 5, 19),
+                                tu.date_to_millis(1993, 5, 20))
+    assert ws[0] == tu.date_to_millis(1993, 5, 17)
+
+
+def test_calendar_boundaries_tz():
+    # midnight in New York is 05:00 UTC (EST, Jan)
+    t0 = tu.date_to_millis(1993, 1, 10)
+    bs = tu.calendar_boundaries("P1D", "America/New_York", t0, t0)
+    d = dt.datetime.fromtimestamp(bs[0] / 1000, tz=dt.timezone.utc)
+    assert d.hour == 5

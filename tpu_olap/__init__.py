@@ -1,0 +1,33 @@
+"""tpu_olap — a TPU-native OLAP query engine.
+
+A from-scratch re-imagining of the capabilities of
+``qliro-marketing-services/spark-druid-olap`` (the Sparkline BI Accelerator,
+see SURVEY.md): a rewrite-rule planner compiles SQL-shaped logical plans into
+a Druid-DSL-like query IR (`tpu_olap.ir`), which lowers to JAX/XLA/Pallas
+scan + segmented-reduce programs over dictionary-encoded columnar segments
+resident in TPU HBM (`tpu_olap.segments`, `tpu_olap.kernels`,
+`tpu_olap.executor`). Partial aggregates merge with XLA collectives over ICI
+(`tpu_olap.executor.sharding`); non-rewritable queries fall back to a pandas
+interpreter (`tpu_olap.planner.fallback`).
+
+Layer map (SURVEY.md §2 ↔ this package):
+  L7 DDL/API            -> tpu_olap.api
+  L6 planner/rules      -> tpu_olap.planner
+  L5 query IR (DSL)     -> tpu_olap.ir
+  L4 relation/metadata  -> tpu_olap.catalog
+  L3 execution/dispatch -> tpu_olap.executor
+  L2 communication      -> tpu_olap.executor.sharding (XLA collectives)
+  L1 storage/scan       -> tpu_olap.segments + tpu_olap.kernels
+  L0 raw data/fallback  -> tpu_olap.planner.fallback
+"""
+
+__version__ = "0.1.0"
+
+__all__ = ["Engine", "__version__"]
+
+
+def __getattr__(name):
+    if name == "Engine":
+        from tpu_olap.api.engine import Engine
+        return Engine
+    raise AttributeError(name)

@@ -1,0 +1,45 @@
+"""Query IR — the Druid-query-DSL-shaped contract between planner and engine.
+
+This is the analog of the reference's `org.sparklinedata.druid` spec-class
+family (SURVEY.md §3.3): pure frozen dataclasses with a Druid-compatible JSON
+round-trip, used (a) by the planner as its compilation target, (b) by the
+executor as its input language, and (c) by parity tests to compare against
+real-Druid semantics.
+"""
+
+from tpu_olap.ir.serde import to_json, query_from_json, from_json  # noqa: F401
+from tpu_olap.ir.expr import Expr, Col, Lit, BinOp, FuncCall, parse_expr  # noqa: F401
+from tpu_olap.ir.granularity import (  # noqa: F401
+    Granularity, AllGranularity, NoneGranularity, PeriodGranularity,
+    DurationGranularity, granularity_from_json,
+)
+from tpu_olap.ir.interval import Interval  # noqa: F401
+from tpu_olap.ir.filters import (  # noqa: F401
+    FilterSpec, SelectorFilter, InFilter, BoundFilter, RegexFilter,
+    LikeFilter, AndFilter, OrFilter, NotFilter, ExpressionFilter,
+    filter_from_json,
+)
+from tpu_olap.ir.dimensions import (  # noqa: F401
+    DimensionSpec, DefaultDimensionSpec, ExtractionDimensionSpec,
+    ExtractionFunctionSpec, TimeFormatExtractionFn, RegexExtractionFn,
+    SubstringExtractionFn, LookupExtractionFn, VirtualColumn,
+)
+from tpu_olap.ir.aggregations import (  # noqa: F401
+    AggregationSpec, CountAggregation, SumAggregation, MinAggregation,
+    MaxAggregation, CardinalityAggregation, HyperUniqueAggregation,
+    ThetaSketchAggregation, FilteredAggregation, aggregation_from_json,
+)
+from tpu_olap.ir.postaggs import (  # noqa: F401
+    PostAggregationSpec, ArithmeticPostAgg, FieldAccessPostAgg,
+    ConstantPostAgg, HyperUniqueCardinalityPostAgg, ThetaSketchEstimatePostAgg,
+)
+from tpu_olap.ir.having import (  # noqa: F401
+    HavingSpec, GreaterThanHaving, LessThanHaving, EqualToHaving,
+    AndHaving, OrHaving, NotHaving, DimSelectorHaving,
+)
+from tpu_olap.ir.limit import LimitSpec, OrderByColumnSpec  # noqa: F401
+from tpu_olap.ir.query import (  # noqa: F401
+    QuerySpec, TimeseriesQuerySpec, GroupByQuerySpec, TopNQuerySpec,
+    ScanQuerySpec, SelectQuerySpec, SearchQuerySpec, SearchQueryContains,
+    SegmentMetadataQuerySpec, TimeBoundaryQuerySpec,
+)

@@ -1,0 +1,193 @@
+"""Aggregation specs: count, sum/min/max, HLL & theta cardinality, filtered.
+
+Mirrors the reference's AggregationSpec family (SURVEY.md §3.3
+"Aggregations"; BASELINE.json:5 "sum/min/max/count, HyperLogLog/Theta
+cardinality"). Long/double variants carry a value_type instead of separate
+classes, but serialize to the Druid type tags (longSum, doubleSum, ...).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tpu_olap.ir.serde import register, from_json
+
+
+class AggregationSpec:
+    name: str
+
+    def field_names(self) -> set[str]:
+        raise NotImplementedError
+
+
+@register("aggregation", "count")
+@dataclass(frozen=True)
+class CountAggregation(AggregationSpec):
+    name: str
+
+    def field_names(self):
+        return set()
+
+    def to_json(self):
+        return {"type": "count", "name": self.name}
+
+    @staticmethod
+    def from_json(d):
+        return CountAggregation(d["name"])
+
+
+@dataclass(frozen=True)
+class SumAggregation(AggregationSpec):
+    name: str
+    field_name: str
+    value_type: str = "double"  # "long" | "double"
+
+    def field_names(self):
+        return {self.field_name}
+
+    def to_json(self):
+        return {"type": f"{self.value_type}Sum", "name": self.name,
+                "fieldName": self.field_name}
+
+
+@dataclass(frozen=True)
+class MinAggregation(AggregationSpec):
+    name: str
+    field_name: str
+    value_type: str = "double"
+
+    def field_names(self):
+        return {self.field_name}
+
+    def to_json(self):
+        return {"type": f"{self.value_type}Min", "name": self.name,
+                "fieldName": self.field_name}
+
+
+@dataclass(frozen=True)
+class MaxAggregation(AggregationSpec):
+    name: str
+    field_name: str
+    value_type: str = "double"
+
+    def field_names(self):
+        return {self.field_name}
+
+    def to_json(self):
+        return {"type": f"{self.value_type}Max", "name": self.name,
+                "fieldName": self.field_name}
+
+
+def _reg_typed(cls, kind_cls, vt):
+    @register("aggregation", f"{vt}{kind_cls}")
+    class _Shim:  # noqa: N801 - registration shim only
+        @staticmethod
+        def from_json(d):
+            return cls(d["name"], d["fieldName"], vt)
+    return _Shim
+
+
+for _vt in ("long", "double", "float"):
+    _reg_typed(SumAggregation, "Sum", _vt)
+    _reg_typed(MinAggregation, "Min", _vt)
+    _reg_typed(MaxAggregation, "Max", _vt)
+
+
+@register("aggregation", "cardinality")
+@dataclass(frozen=True)
+class CardinalityAggregation(AggregationSpec):
+    """Approximate COUNT(DISTINCT dims...) via HyperLogLog over dimension
+    values at query time (reference: COUNT(DISTINCT dim) -> cardinality
+    aggregator, SURVEY.md §3.2 AggregateTransform)."""
+
+    name: str
+    fields: tuple
+    by_row: bool = False
+    round: bool = True
+
+    def field_names(self):
+        return set(self.fields)
+
+    def to_json(self):
+        return {"type": "cardinality", "name": self.name,
+                "fields": list(self.fields), "byRow": self.by_row,
+                "round": self.round}
+
+    @staticmethod
+    def from_json(d):
+        return CardinalityAggregation(d["name"], tuple(d["fields"]),
+                                      bool(d.get("byRow", False)),
+                                      bool(d.get("round", True)))
+
+
+@register("aggregation", "hyperUnique")
+@dataclass(frozen=True)
+class HyperUniqueAggregation(AggregationSpec):
+    """HLL over a single column (reference: hyperUnique over a pre-built HLL
+    metric column; here computed from the raw column at query time)."""
+
+    name: str
+    field_name: str
+    round: bool = True
+
+    def field_names(self):
+        return {self.field_name}
+
+    def to_json(self):
+        return {"type": "hyperUnique", "name": self.name,
+                "fieldName": self.field_name, "round": self.round}
+
+    @staticmethod
+    def from_json(d):
+        return HyperUniqueAggregation(d["name"], d["fieldName"],
+                                      bool(d.get("round", True)))
+
+
+@register("aggregation", "thetaSketch")
+@dataclass(frozen=True)
+class ThetaSketchAggregation(AggregationSpec):
+    """Theta (KMV) sketch count-distinct — the datasketches-extension analog
+    (SURVEY.md §3.3: Theta-sketch aggregator)."""
+
+    name: str
+    field_name: str
+    size: int = 16384  # nominal entries (k)
+
+    def field_names(self):
+        return {self.field_name}
+
+    def to_json(self):
+        return {"type": "thetaSketch", "name": self.name,
+                "fieldName": self.field_name, "size": self.size}
+
+    @staticmethod
+    def from_json(d):
+        return ThetaSketchAggregation(d["name"], d["fieldName"],
+                                      int(d.get("size", 16384)))
+
+
+@register("aggregation", "filtered")
+@dataclass(frozen=True)
+class FilteredAggregation(AggregationSpec):
+    filter: object  # FilterSpec
+    aggregator: AggregationSpec
+
+    @property
+    def name(self):
+        return self.aggregator.name
+
+    def field_names(self):
+        return self.aggregator.field_names() | self.filter.columns()
+
+    def to_json(self):
+        return {"type": "filtered", "filter": self.filter.to_json(),
+                "aggregator": self.aggregator.to_json()}
+
+    @staticmethod
+    def from_json(d):
+        return FilteredAggregation(from_json("filter", d["filter"]),
+                                   from_json("aggregation", d["aggregator"]))
+
+
+def aggregation_from_json(d):
+    return from_json("aggregation", d)

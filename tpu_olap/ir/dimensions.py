@@ -1,0 +1,170 @@
+"""Dimension specs, extraction functions, and virtual columns.
+
+Mirrors the reference's DefaultDimensionSpec / ExtractionDimensionSpec with
+TimeFormat/regex/lookup extraction fns (SURVEY.md §3.3 "Dimensions"); the
+javascript extraction fn is dropped in favor of expression virtual columns.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tpu_olap.ir.expr import Expr
+from tpu_olap.ir.serde import register, from_json
+
+
+class ExtractionFunctionSpec:
+    pass
+
+
+@register("extractionFn", "timeFormat")
+@dataclass(frozen=True)
+class TimeFormatExtractionFn(ExtractionFunctionSpec):
+    """strftime-style formatting of the time dimension, tz-aware.
+
+    The reference emits joda format strings from Spark date functions
+    (SparkNativeTimeElementExtractor, SURVEY.md §3.2); we use strftime
+    patterns, plus shorthands: "YYYY" (year), "MM" (month), "dd" (day of
+    month) which the planner emits for year()/month()/dayofmonth().
+    """
+
+    format: str
+    time_zone: str = "UTC"
+    granularity: object | None = None  # optional pre-bucketing
+
+    def to_json(self):
+        d = {"type": "timeFormat", "format": self.format, "timeZone": self.time_zone}
+        if self.granularity is not None:
+            d["granularity"] = self.granularity.to_json()
+        return d
+
+    @staticmethod
+    def from_json(d):
+        from tpu_olap.ir.granularity import granularity_from_json
+        g = granularity_from_json(d["granularity"]) if "granularity" in d else None
+        return TimeFormatExtractionFn(d["format"], d.get("timeZone", "UTC"), g)
+
+
+@register("extractionFn", "regex")
+@dataclass(frozen=True)
+class RegexExtractionFn(ExtractionFunctionSpec):
+    expr: str
+    replace_missing_value: str | None = None
+
+    def to_json(self):
+        return {"type": "regex", "expr": self.expr,
+                "replaceMissingValue": self.replace_missing_value}
+
+    @staticmethod
+    def from_json(d):
+        return RegexExtractionFn(d["expr"], d.get("replaceMissingValue"))
+
+
+@register("extractionFn", "substring")
+@dataclass(frozen=True)
+class SubstringExtractionFn(ExtractionFunctionSpec):
+    index: int
+    length: int | None = None
+
+    def to_json(self):
+        return {"type": "substring", "index": self.index, "length": self.length}
+
+    @staticmethod
+    def from_json(d):
+        return SubstringExtractionFn(int(d["index"]), d.get("length"))
+
+
+@register("extractionFn", "lookup")
+@dataclass(frozen=True)
+class LookupExtractionFn(ExtractionFunctionSpec):
+    lookup: tuple  # tuple of (key, value) pairs
+    retain_missing_value: bool = False
+    replace_missing_value: str | None = None
+
+    def to_json(self):
+        return {"type": "lookup",
+                "lookup": {"type": "map", "map": dict(self.lookup)},
+                "retainMissingValue": self.retain_missing_value,
+                "replaceMissingValueWith": self.replace_missing_value}
+
+    @staticmethod
+    def from_json(d):
+        m = d["lookup"]["map"]
+        return LookupExtractionFn(tuple(sorted(m.items())),
+                                  bool(d.get("retainMissingValue", False)),
+                                  d.get("replaceMissingValueWith"))
+
+
+class DimensionSpec:
+    pass
+
+
+@register("dimension", "default")
+@dataclass(frozen=True)
+class DefaultDimensionSpec(DimensionSpec):
+    dimension: str
+    output_name: str | None = None
+
+    @property
+    def name(self):
+        return self.output_name or self.dimension
+
+    def to_json(self):
+        d = {"type": "default", "dimension": self.dimension}
+        if self.output_name is not None:
+            d["outputName"] = self.output_name
+        return d
+
+    @staticmethod
+    def from_json(d):
+        return DefaultDimensionSpec(d["dimension"], d.get("outputName"))
+
+
+@register("dimension", "extraction")
+@dataclass(frozen=True)
+class ExtractionDimensionSpec(DimensionSpec):
+    dimension: str
+    extraction_fn: ExtractionFunctionSpec
+    output_name: str | None = None
+
+    @property
+    def name(self):
+        return self.output_name or self.dimension
+
+    def to_json(self):
+        d = {"type": "extraction", "dimension": self.dimension,
+             "extractionFn": self.extraction_fn.to_json()}
+        if self.output_name is not None:
+            d["outputName"] = self.output_name
+        return d
+
+    @staticmethod
+    def from_json(d):
+        return ExtractionDimensionSpec(
+            d["dimension"], from_json("extractionFn", d["extractionFn"]),
+            d.get("outputName"))
+
+
+def dimension_from_json(d) -> DimensionSpec:
+    if isinstance(d, str):  # Druid shorthand: bare column name
+        return DefaultDimensionSpec(d)
+    return from_json("dimension", d)
+
+
+@dataclass(frozen=True)
+class VirtualColumn:
+    """Expression virtual column — input to aggregators/filters/dimensions."""
+
+    name: str
+    expression: Expr
+    output_type: str = "double"  # double | long | string
+
+    def to_json(self):
+        return {"type": "expression", "name": self.name,
+                "expression": self.expression.to_json(),
+                "outputType": self.output_type}
+
+    @staticmethod
+    def from_json(d):
+        return VirtualColumn(d["name"], from_json("expr", d["expression"]),
+                             d.get("outputType", "double"))

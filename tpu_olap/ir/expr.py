@@ -1,0 +1,238 @@
+"""Scalar row-expression AST for virtual columns and expression filters.
+
+Druid's escape hatches were javascript aggregators/filters and (in modern
+Druid) expression virtual columns (SURVEY.md §3.3). We keep a small typed
+arithmetic/comparison expression language instead: enough to express
+projected aggregate inputs (e.g. SSB Q1.1's sum(lo_extendedprice *
+lo_discount)) and residual predicates, and directly evaluable with
+numpy/jax without an interpreter loop.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+from tpu_olap.ir.serde import register
+
+
+class Expr:
+    def columns(self) -> set[str]:
+        raise NotImplementedError
+
+    def __mul__(self, o): return BinOp("*", self, _wrap(o))
+    def __add__(self, o): return BinOp("+", self, _wrap(o))
+    def __sub__(self, o): return BinOp("-", self, _wrap(o))
+    def __truediv__(self, o): return BinOp("/", self, _wrap(o))
+
+
+def _wrap(v) -> "Expr":
+    if isinstance(v, Expr):
+        return v
+    return Lit(v)
+
+
+@register("expr", "col")
+@dataclass(frozen=True)
+class Col(Expr):
+    name: str
+
+    def columns(self):
+        return {self.name}
+
+    def to_json(self):
+        return {"type": "col", "name": self.name}
+
+    @staticmethod
+    def from_json(d):
+        return Col(d["name"])
+
+
+@register("expr", "lit")
+@dataclass(frozen=True)
+class Lit(Expr):
+    value: float | int | str | bool | None
+
+    def columns(self):
+        return set()
+
+    def to_json(self):
+        return {"type": "lit", "value": self.value}
+
+    @staticmethod
+    def from_json(d):
+        return Lit(d["value"])
+
+
+@register("expr", "binop")
+@dataclass(frozen=True)
+class BinOp(Expr):
+    op: str  # + - * / % == != < <= > >= && ||
+    left: Expr
+    right: Expr
+
+    def columns(self):
+        return self.left.columns() | self.right.columns()
+
+    def to_json(self):
+        return {"type": "binop", "op": self.op,
+                "left": self.left.to_json(), "right": self.right.to_json()}
+
+    @staticmethod
+    def from_json(d):
+        from tpu_olap.ir.serde import from_json
+        return BinOp(d["op"], from_json("expr", d["left"]),
+                     from_json("expr", d["right"]))
+
+
+@register("expr", "func")
+@dataclass(frozen=True)
+class FuncCall(Expr):
+    name: str  # abs, floor, ceil, sqrt, log, exp, if
+    args: tuple
+
+    def columns(self):
+        out = set()
+        for a in self.args:
+            out |= a.columns()
+        return out
+
+    def to_json(self):
+        return {"type": "func", "name": self.name,
+                "args": [a.to_json() for a in self.args]}
+
+    @staticmethod
+    def from_json(d):
+        from tpu_olap.ir.serde import from_json
+        return FuncCall(d["name"], tuple(from_json("expr", a) for a in d["args"]))
+
+
+# ---------------------------------------------------------------------------
+# Tiny recursive-descent parser for expression strings: "a * b + 2.5"
+
+_TOKEN_RE = re.compile(
+    r"\s*(?:(?P<num>\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)"
+    r"|(?P<name>[A-Za-z_][A-Za-z0-9_.]*)"
+    r"|(?P<str>'[^']*')"
+    r"|(?P<op>==|!=|<=|>=|&&|\|\||[-+*/%()<>,]))"
+)
+
+_CMP_OPS = ("==", "!=", "<=", ">=", "<", ">")
+
+
+def _tokenize(s: str):
+    pos, out = 0, []
+    while pos < len(s):
+        m = _TOKEN_RE.match(s, pos)
+        if not m:
+            if s[pos:].strip() == "":
+                break
+            raise ValueError(f"bad token at {s[pos:]!r}")
+        pos = m.end()
+        if m.lastgroup == "num":
+            t = m.group("num")
+            out.append(("num", float(t) if ("." in t or "e" in t or "E" in t) else int(t)))
+        elif m.lastgroup == "name":
+            out.append(("name", m.group("name")))
+        elif m.lastgroup == "str":
+            out.append(("str", m.group("str")[1:-1]))
+        else:
+            out.append(("op", m.group("op")))
+    out.append(("eof", None))
+    return out
+
+
+class _P:
+    def __init__(self, toks):
+        self.toks = toks
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i]
+
+    def take(self, kind=None, val=None):
+        k, v = self.toks[self.i]
+        if kind and k != kind or (val is not None and v != val):
+            raise ValueError(f"expected {kind}:{val}, got {k}:{v}")
+        self.i += 1
+        return v
+
+    def expr(self):
+        return self.or_()
+
+    def or_(self):
+        e = self.and_()
+        while self.peek() == ("op", "||"):
+            self.take()
+            e = BinOp("||", e, self.and_())
+        return e
+
+    def and_(self):
+        e = self.cmp()
+        while self.peek() == ("op", "&&"):
+            self.take()
+            e = BinOp("&&", e, self.cmp())
+        return e
+
+    def cmp(self):
+        e = self.add()
+        k, v = self.peek()
+        if k == "op" and v in _CMP_OPS:
+            self.take()
+            e = BinOp(v, e, self.add())
+        return e
+
+    def add(self):
+        e = self.mul()
+        while self.peek()[0] == "op" and self.peek()[1] in ("+", "-"):
+            op = self.take()
+            e = BinOp(op, e, self.mul())
+        return e
+
+    def mul(self):
+        e = self.unary()
+        while self.peek()[0] == "op" and self.peek()[1] in ("*", "/", "%"):
+            op = self.take()
+            e = BinOp(op, e, self.unary())
+        return e
+
+    def unary(self):
+        if self.peek() == ("op", "-"):
+            self.take()
+            return BinOp("-", Lit(0), self.unary())
+        return self.atom()
+
+    def atom(self):
+        k, v = self.peek()
+        if k == "num":
+            self.take()
+            return Lit(v)
+        if k == "str":
+            self.take()
+            return Lit(v)
+        if k == "name":
+            self.take()
+            if self.peek() == ("op", "("):
+                self.take()
+                args = []
+                if self.peek() != ("op", ")"):
+                    args.append(self.expr())
+                    while self.peek() == ("op", ","):
+                        self.take()
+                        args.append(self.expr())
+                self.take("op", ")")
+                return FuncCall(v, tuple(args))
+            return Col(v)
+        if (k, v) == ("op", "("):
+            self.take()
+            e = self.expr()
+            self.take("op", ")")
+            return e
+        raise ValueError(f"unexpected token {k}:{v}")
+
+
+def parse_expr(s: str) -> Expr:
+    p = _P(_tokenize(s))
+    e = p.expr()
+    p.take("eof")
+    return e

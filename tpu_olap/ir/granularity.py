@@ -1,0 +1,103 @@
+"""Granularity specs: all | none | period (tz-aware) | duration.
+
+Mirrors the reference's granularity model (SURVEY.md §3.3 "Granularity"),
+which drives time bucketing for Timeseries/GroupBy. Simple string forms
+("all", "hour", "day", ...) are accepted in JSON like Druid does.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tpu_olap.ir.serde import register
+from tpu_olap.utils import timeutil
+
+_SIMPLE = {
+    "second": "PT1S", "minute": "PT1M", "fifteen_minute": "PT15M",
+    "thirty_minute": "PT30M", "hour": "PT1H", "six_hour": "PT6H",
+    "day": "P1D", "week": "P1W", "month": "P1M", "quarter": "P3M",
+    "year": "P1Y",
+}
+
+
+class Granularity:
+    pass
+
+
+@register("granularity", "all")
+@dataclass(frozen=True)
+class AllGranularity(Granularity):
+    def to_json(self):
+        return {"type": "all"}
+
+    @staticmethod
+    def from_json(d):
+        return AllGranularity()
+
+
+@register("granularity", "none")
+@dataclass(frozen=True)
+class NoneGranularity(Granularity):
+    """Bucket per distinct timestamp (Druid 'none' ~ millisecond buckets)."""
+
+    def to_json(self):
+        return {"type": "none"}
+
+    @staticmethod
+    def from_json(d):
+        return NoneGranularity()
+
+
+@register("granularity", "period")
+@dataclass(frozen=True)
+class PeriodGranularity(Granularity):
+    period: str  # ISO-8601: PT1H, P1D, P1M, ...
+    time_zone: str = "UTC"
+    origin: int | None = None  # epoch millis; None = natural calendar origin
+
+    def is_uniform(self) -> bool:
+        """Fixed-duration bucketing valid (no calendar months/years, UTC)."""
+        return timeutil.period_is_uniform(self.period) and self.time_zone == "UTC"
+
+    def to_json(self):
+        d = {"type": "period", "period": self.period, "timeZone": self.time_zone}
+        if self.origin is not None:
+            d["origin"] = timeutil.millis_to_iso(self.origin)
+        return d
+
+    @staticmethod
+    def from_json(d):
+        origin = d.get("origin")
+        if isinstance(origin, str):
+            origin = timeutil.parse_iso_datetime(origin)
+        return PeriodGranularity(d["period"], d.get("timeZone", "UTC"), origin)
+
+
+@register("granularity", "duration")
+@dataclass(frozen=True)
+class DurationGranularity(Granularity):
+    duration: int  # millis
+    origin: int = 0
+
+    def to_json(self):
+        return {"type": "duration", "duration": self.duration, "origin": self.origin}
+
+    @staticmethod
+    def from_json(d):
+        return DurationGranularity(int(d["duration"]), int(d.get("origin", 0)))
+
+
+def granularity_from_json(d) -> Granularity:
+    from tpu_olap.ir.serde import from_json
+    if d is None:
+        return AllGranularity()
+    if isinstance(d, str):
+        s = d.lower()
+        if s == "all":
+            return AllGranularity()
+        if s == "none":
+            return NoneGranularity()
+        if s in _SIMPLE:
+            return PeriodGranularity(_SIMPLE[s])
+        raise ValueError(f"unknown simple granularity {d!r}")
+    return from_json("granularity", d)

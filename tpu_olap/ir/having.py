@@ -1,0 +1,119 @@
+"""Having specs for GroupBy: >, <, =, and/or/not, dim selector.
+
+Mirrors the reference's HavingSpec family (SURVEY.md §3.3 "Having").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tpu_olap.ir.serde import register, from_json
+
+
+class HavingSpec:
+    pass
+
+
+@register("having", "greaterThan")
+@dataclass(frozen=True)
+class GreaterThanHaving(HavingSpec):
+    aggregation: str
+    value: float
+
+    def to_json(self):
+        return {"type": "greaterThan", "aggregation": self.aggregation,
+                "value": self.value}
+
+    @staticmethod
+    def from_json(d):
+        return GreaterThanHaving(d["aggregation"], d["value"])
+
+
+@register("having", "lessThan")
+@dataclass(frozen=True)
+class LessThanHaving(HavingSpec):
+    aggregation: str
+    value: float
+
+    def to_json(self):
+        return {"type": "lessThan", "aggregation": self.aggregation,
+                "value": self.value}
+
+    @staticmethod
+    def from_json(d):
+        return LessThanHaving(d["aggregation"], d["value"])
+
+
+@register("having", "equalTo")
+@dataclass(frozen=True)
+class EqualToHaving(HavingSpec):
+    aggregation: str
+    value: float
+
+    def to_json(self):
+        return {"type": "equalTo", "aggregation": self.aggregation,
+                "value": self.value}
+
+    @staticmethod
+    def from_json(d):
+        return EqualToHaving(d["aggregation"], d["value"])
+
+
+@register("having", "dimSelector")
+@dataclass(frozen=True)
+class DimSelectorHaving(HavingSpec):
+    dimension: str
+    value: str
+
+    def to_json(self):
+        return {"type": "dimSelector", "dimension": self.dimension,
+                "value": self.value}
+
+    @staticmethod
+    def from_json(d):
+        return DimSelectorHaving(d["dimension"], d["value"])
+
+
+@register("having", "and")
+@dataclass(frozen=True)
+class AndHaving(HavingSpec):
+    having_specs: tuple
+
+    def to_json(self):
+        return {"type": "and",
+                "havingSpecs": [h.to_json() for h in self.having_specs]}
+
+    @staticmethod
+    def from_json(d):
+        return AndHaving(tuple(from_json("having", h) for h in d["havingSpecs"]))
+
+
+@register("having", "or")
+@dataclass(frozen=True)
+class OrHaving(HavingSpec):
+    having_specs: tuple
+
+    def to_json(self):
+        return {"type": "or",
+                "havingSpecs": [h.to_json() for h in self.having_specs]}
+
+    @staticmethod
+    def from_json(d):
+        return OrHaving(tuple(from_json("having", h) for h in d["havingSpecs"]))
+
+
+@register("having", "not")
+@dataclass(frozen=True)
+class NotHaving(HavingSpec):
+    having_spec: HavingSpec
+
+    def to_json(self):
+        return {"type": "not", "havingSpec": self.having_spec.to_json()}
+
+    @staticmethod
+    def from_json(d):
+        return NotHaving(from_json("having", d["havingSpec"]))
+
+
+def having_from_json(d):
+    return from_json("having", d)

@@ -1,0 +1,56 @@
+"""ISO-8601 query intervals — the time-partition pruning mechanism.
+
+Reference: per-query interval lists restrict which Druid segments are
+touched (SURVEY.md §3.3 "Intervals", §3.5 P4). Here they prune the segment
+manifest before dispatch and clamp the time filter in-kernel.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tpu_olap.utils import timeutil
+
+
+@dataclass(frozen=True)
+class Interval:
+    """Half-open [start, end) in epoch millis UTC."""
+
+    start: int
+    end: int
+
+    @staticmethod
+    def parse(s: str) -> "Interval":
+        a, b = s.split("/")
+        return Interval(timeutil.parse_iso_datetime(a), timeutil.parse_iso_datetime(b))
+
+    @staticmethod
+    def of(start, end) -> "Interval":
+        if isinstance(start, str):
+            start = timeutil.parse_iso_datetime(start)
+        if isinstance(end, str):
+            end = timeutil.parse_iso_datetime(end)
+        return Interval(int(start), int(end))
+
+    def to_json(self) -> str:
+        return f"{timeutil.millis_to_iso(self.start)}/{timeutil.millis_to_iso(self.end)}"
+
+    def overlaps(self, start: int, end: int) -> bool:
+        return self.start < end and start < self.end
+
+    def intersect(self, other: "Interval") -> "Interval | None":
+        s, e = max(self.start, other.start), min(self.end, other.end)
+        return Interval(s, e) if s < e else None
+
+
+ETERNITY = Interval(-(2**62), 2**62)
+
+
+def intervals_from_json(lst) -> tuple[Interval, ...]:
+    if not lst:
+        return ()
+    return tuple(Interval.parse(s) if isinstance(s, str) else s for s in lst)
+
+
+def intervals_to_json(ivals) -> list[str]:
+    return [iv.to_json() for iv in ivals]

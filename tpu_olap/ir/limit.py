@@ -1,0 +1,55 @@
+"""LimitSpec: multi-column ordered limit on GroupBy results.
+
+Mirrors the reference's LimitSpec + OrderByColumnSpec (SURVEY.md §3.3
+"Limit"); TopN queries carry their own (dimension, metric, threshold).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class OrderByColumnSpec:
+    dimension: str  # a dimension output name or aggregator/post-agg name
+    direction: str = "ascending"  # ascending | descending
+    dimension_order: str = "lexicographic"  # lexicographic | numeric
+
+    def to_json(self):
+        return {"dimension": self.dimension, "direction": self.direction,
+                "dimensionOrder": {"type": self.dimension_order}}
+
+    @staticmethod
+    def from_json(d):
+        if isinstance(d, str):
+            return OrderByColumnSpec(d)
+        order = d.get("dimensionOrder", "lexicographic")
+        if isinstance(order, dict):
+            order = order.get("type", "lexicographic")
+        return OrderByColumnSpec(d["dimension"], d.get("direction", "ascending"),
+                                 order)
+
+
+@dataclass(frozen=True)
+class LimitSpec:
+    limit: int | None = None
+    columns: tuple = field(default_factory=tuple)  # OrderByColumnSpec
+    offset: int = 0
+
+    def to_json(self):
+        d = {"type": "default",
+             "columns": [c.to_json() for c in self.columns]}
+        if self.limit is not None:
+            d["limit"] = self.limit
+        if self.offset:
+            d["offset"] = self.offset
+        return d
+
+    @staticmethod
+    def from_json(d):
+        if d is None:
+            return None
+        return LimitSpec(d.get("limit"),
+                         tuple(OrderByColumnSpec.from_json(c)
+                               for c in d.get("columns", [])),
+                         int(d.get("offset", 0)))

@@ -1,0 +1,122 @@
+"""Post-aggregation specs: arithmetic, field access, constant, HLL finalize.
+
+Mirrors the reference's PostAggregationSpec family (SURVEY.md §3.3
+"Post-aggregations") — e.g. AVG is compiled to doubleSum/count arithmetic.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tpu_olap.ir.serde import register, from_json
+
+
+class PostAggregationSpec:
+    name: str
+
+    def inputs(self) -> set[str]:
+        """Names of aggregator / post-agg outputs this reads."""
+        raise NotImplementedError
+
+
+@register("postAggregation", "arithmetic")
+@dataclass(frozen=True)
+class ArithmeticPostAgg(PostAggregationSpec):
+    name: str
+    fn: str  # + - * / quotient
+    fields: tuple
+
+    def inputs(self):
+        out = set()
+        for f in self.fields:
+            out |= f.inputs()
+        return out
+
+    def to_json(self):
+        return {"type": "arithmetic", "name": self.name, "fn": self.fn,
+                "fields": [f.to_json() for f in self.fields]}
+
+    @staticmethod
+    def from_json(d):
+        return ArithmeticPostAgg(d["name"], d["fn"],
+                                 tuple(from_json("postAggregation", f)
+                                       for f in d["fields"]))
+
+
+@register("postAggregation", "fieldAccess")
+@dataclass(frozen=True)
+class FieldAccessPostAgg(PostAggregationSpec):
+    field_name: str
+    name: str = ""
+
+    def inputs(self):
+        return {self.field_name}
+
+    def to_json(self):
+        return {"type": "fieldAccess", "name": self.name,
+                "fieldName": self.field_name}
+
+    @staticmethod
+    def from_json(d):
+        return FieldAccessPostAgg(d["fieldName"], d.get("name", ""))
+
+
+@register("postAggregation", "constant")
+@dataclass(frozen=True)
+class ConstantPostAgg(PostAggregationSpec):
+    value: float
+    name: str = ""
+
+    def inputs(self):
+        return set()
+
+    def to_json(self):
+        return {"type": "constant", "name": self.name, "value": self.value}
+
+    @staticmethod
+    def from_json(d):
+        return ConstantPostAgg(d["value"], d.get("name", ""))
+
+
+@register("postAggregation", "hyperUniqueCardinality")
+@dataclass(frozen=True)
+class HyperUniqueCardinalityPostAgg(PostAggregationSpec):
+    """Finalize an HLL aggregator output to a (float) cardinality estimate."""
+
+    field_name: str
+    name: str = ""
+
+    def inputs(self):
+        return {self.field_name}
+
+    def to_json(self):
+        return {"type": "hyperUniqueCardinality", "name": self.name,
+                "fieldName": self.field_name}
+
+    @staticmethod
+    def from_json(d):
+        return HyperUniqueCardinalityPostAgg(d["fieldName"], d.get("name", ""))
+
+
+@register("postAggregation", "thetaSketchEstimate")
+@dataclass(frozen=True)
+class ThetaSketchEstimatePostAgg(PostAggregationSpec):
+    field_name: str
+    name: str = ""
+
+    def inputs(self):
+        return {self.field_name}
+
+    def to_json(self):
+        return {"type": "thetaSketchEstimate", "name": self.name,
+                "field": {"type": "fieldAccess", "fieldName": self.field_name}}
+
+    @staticmethod
+    def from_json(d):
+        fld = d.get("field", {})
+        fn = d.get("fieldName") or fld.get("fieldName")
+        return ThetaSketchEstimatePostAgg(fn, d.get("name", ""))
+
+
+def postagg_from_json(d):
+    return from_json("postAggregation", d)

@@ -1,0 +1,364 @@
+"""QuerySpec hierarchy: GroupBy, Timeseries, TopN, Scan, Select, Search,
+SegmentMetadata, TimeBoundary.
+
+Mirrors the reference's query-type family (SURVEY.md §3.3 "Query types";
+BASELINE.json:5 names GroupBy/TimeSeries/TopN). Each is a frozen dataclass
+with Druid-shaped JSON round-trip; the executor lowers these to jitted XLA
+programs (tpu_olap.executor.lowering).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tpu_olap.ir.aggregations import AggregationSpec, aggregation_from_json
+from tpu_olap.ir.dimensions import (DimensionSpec, VirtualColumn,
+                                    dimension_from_json)
+from tpu_olap.ir.filters import FilterSpec, filter_from_json
+from tpu_olap.ir.granularity import (AllGranularity, Granularity,
+                                     granularity_from_json)
+from tpu_olap.ir.having import HavingSpec, having_from_json
+from tpu_olap.ir.interval import (Interval, intervals_from_json,
+                                  intervals_to_json)
+from tpu_olap.ir.limit import LimitSpec
+from tpu_olap.ir.postaggs import PostAggregationSpec, postagg_from_json
+from tpu_olap.ir.serde import register
+
+
+@dataclass(frozen=True)
+class QuerySpec:
+    data_source: str
+    intervals: tuple = field(default_factory=tuple)  # tuple[Interval]
+    filter: FilterSpec | None = None
+    virtual_columns: tuple = field(default_factory=tuple)
+    context: tuple = field(default_factory=tuple)  # sorted (k, v) pairs
+
+    @property
+    def query_type(self) -> str:
+        return type(self).query_type_name  # type: ignore[attr-defined]
+
+    def context_dict(self) -> dict:
+        return dict(self.context)
+
+    def _common_json(self, d: dict) -> dict:
+        d["dataSource"] = self.data_source
+        d["intervals"] = intervals_to_json(self.intervals) if self.intervals else []
+        if self.filter is not None:
+            d["filter"] = self.filter.to_json()
+        if self.virtual_columns:
+            d["virtualColumns"] = [v.to_json() for v in self.virtual_columns]
+        if self.context:
+            d["context"] = dict(self.context)
+        return d
+
+    @staticmethod
+    def _common_kwargs(d: dict) -> dict:
+        return dict(
+            data_source=d["dataSource"] if isinstance(d["dataSource"], str)
+            else d["dataSource"]["name"],
+            intervals=intervals_from_json(d.get("intervals")),
+            filter=filter_from_json(d["filter"]) if d.get("filter") else None,
+            virtual_columns=tuple(VirtualColumn.from_json(v)
+                                  for v in d.get("virtualColumns", [])),
+            context=tuple(sorted(d.get("context", {}).items())),
+        )
+
+
+@register("query", "timeseries")
+@dataclass(frozen=True)
+class TimeseriesQuerySpec(QuerySpec):
+    query_type_name = "timeseries"
+
+    granularity: Granularity = field(default_factory=AllGranularity)
+    aggregations: tuple = field(default_factory=tuple)
+    post_aggregations: tuple = field(default_factory=tuple)
+    descending: bool = False
+
+    def to_json(self):
+        d = {"queryType": "timeseries", "type": "timeseries"}
+        self._common_json(d)
+        d["granularity"] = self.granularity.to_json()
+        d["aggregations"] = [a.to_json() for a in self.aggregations]
+        if self.post_aggregations:
+            d["postAggregations"] = [p.to_json() for p in self.post_aggregations]
+        if self.descending:
+            d["descending"] = True
+        return d
+
+    @staticmethod
+    def from_json(d):
+        return TimeseriesQuerySpec(
+            granularity=granularity_from_json(d.get("granularity")),
+            aggregations=tuple(aggregation_from_json(a)
+                               for a in d.get("aggregations", [])),
+            post_aggregations=tuple(postagg_from_json(p)
+                                    for p in d.get("postAggregations", [])),
+            descending=bool(d.get("descending", False)),
+            **QuerySpec._common_kwargs(d),
+        )
+
+
+@register("query", "groupBy")
+@dataclass(frozen=True)
+class GroupByQuerySpec(QuerySpec):
+    query_type_name = "groupBy"
+
+    dimensions: tuple = field(default_factory=tuple)
+    granularity: Granularity = field(default_factory=AllGranularity)
+    aggregations: tuple = field(default_factory=tuple)
+    post_aggregations: tuple = field(default_factory=tuple)
+    having: HavingSpec | None = None
+    limit_spec: LimitSpec | None = None
+
+    def to_json(self):
+        d = {"queryType": "groupBy", "type": "groupBy"}
+        self._common_json(d)
+        d["dimensions"] = [x.to_json() for x in self.dimensions]
+        d["granularity"] = self.granularity.to_json()
+        d["aggregations"] = [a.to_json() for a in self.aggregations]
+        if self.post_aggregations:
+            d["postAggregations"] = [p.to_json() for p in self.post_aggregations]
+        if self.having is not None:
+            d["having"] = self.having.to_json()
+        if self.limit_spec is not None:
+            d["limitSpec"] = self.limit_spec.to_json()
+        return d
+
+    @staticmethod
+    def from_json(d):
+        return GroupByQuerySpec(
+            dimensions=tuple(dimension_from_json(x)
+                             for x in d.get("dimensions", [])),
+            granularity=granularity_from_json(d.get("granularity")),
+            aggregations=tuple(aggregation_from_json(a)
+                               for a in d.get("aggregations", [])),
+            post_aggregations=tuple(postagg_from_json(p)
+                                    for p in d.get("postAggregations", [])),
+            having=having_from_json(d["having"]) if d.get("having") else None,
+            limit_spec=LimitSpec.from_json(d.get("limitSpec")),
+            **QuerySpec._common_kwargs(d),
+        )
+
+
+@register("query", "topN")
+@dataclass(frozen=True)
+class TopNQuerySpec(QuerySpec):
+    query_type_name = "topN"
+
+    dimension: DimensionSpec = None  # type: ignore[assignment]
+    metric: str = ""
+    threshold: int = 0
+    granularity: Granularity = field(default_factory=AllGranularity)
+    aggregations: tuple = field(default_factory=tuple)
+    post_aggregations: tuple = field(default_factory=tuple)
+
+    def to_json(self):
+        d = {"queryType": "topN", "type": "topN"}
+        self._common_json(d)
+        d["dimension"] = self.dimension.to_json()
+        d["metric"] = self.metric
+        d["threshold"] = self.threshold
+        d["granularity"] = self.granularity.to_json()
+        d["aggregations"] = [a.to_json() for a in self.aggregations]
+        if self.post_aggregations:
+            d["postAggregations"] = [p.to_json() for p in self.post_aggregations]
+        return d
+
+    @staticmethod
+    def from_json(d):
+        metric = d["metric"]
+        if isinstance(metric, dict):
+            metric = metric.get("metric", metric.get("fieldName", ""))
+        return TopNQuerySpec(
+            dimension=dimension_from_json(d["dimension"]),
+            metric=metric,
+            threshold=int(d["threshold"]),
+            granularity=granularity_from_json(d.get("granularity")),
+            aggregations=tuple(aggregation_from_json(a)
+                               for a in d.get("aggregations", [])),
+            post_aggregations=tuple(postagg_from_json(p)
+                                    for p in d.get("postAggregations", [])),
+            **QuerySpec._common_kwargs(d),
+        )
+
+
+@register("query", "scan")
+@dataclass(frozen=True)
+class ScanQuerySpec(QuerySpec):
+    query_type_name = "scan"
+
+    columns: tuple = field(default_factory=tuple)  # () = all columns
+    limit: int | None = None
+    offset: int = 0
+    order: str = "none"  # none | ascending | descending (by __time)
+
+    def to_json(self):
+        d = {"queryType": "scan", "type": "scan"}
+        self._common_json(d)
+        d["columns"] = list(self.columns)
+        if self.limit is not None:
+            d["limit"] = self.limit
+        if self.offset:
+            d["offset"] = self.offset
+        d["order"] = self.order
+        return d
+
+    @staticmethod
+    def from_json(d):
+        return ScanQuerySpec(
+            columns=tuple(d.get("columns", [])),
+            limit=d.get("limit"),
+            offset=int(d.get("offset", 0)),
+            order=d.get("order", "none"),
+            **QuerySpec._common_kwargs(d),
+        )
+
+
+@register("query", "select")
+@dataclass(frozen=True)
+class SelectQuerySpec(QuerySpec):
+    """Legacy paged row fetch (reference SelectSpec, SURVEY.md §3.3/§4.4).
+
+    Paging: paging_offset is the row offset into the time-ordered result;
+    results carry the next offset as a paging identifier.
+    """
+
+    query_type_name = "select"
+
+    dimensions: tuple = field(default_factory=tuple)  # bare names
+    metrics: tuple = field(default_factory=tuple)
+    page_size: int = 1000
+    paging_offset: int = 0
+    descending: bool = False
+
+    def to_json(self):
+        d = {"queryType": "select", "type": "select"}
+        self._common_json(d)
+        d["dimensions"] = list(self.dimensions)
+        d["metrics"] = list(self.metrics)
+        d["pagingSpec"] = {"threshold": self.page_size,
+                           "pagingIdentifiers": {"offset": self.paging_offset}}
+        if self.descending:
+            d["descending"] = True
+        return d
+
+    @staticmethod
+    def from_json(d):
+        paging = d.get("pagingSpec", {})
+        ids = paging.get("pagingIdentifiers", {})
+        return SelectQuerySpec(
+            dimensions=tuple(d.get("dimensions", [])),
+            metrics=tuple(d.get("metrics", [])),
+            page_size=int(paging.get("threshold", 1000)),
+            paging_offset=int(ids.get("offset", 0)),
+            descending=bool(d.get("descending", False)),
+            **QuerySpec._common_kwargs(d),
+        )
+
+
+@dataclass(frozen=True)
+class SearchQueryContains:
+    value: str
+    case_sensitive: bool = False
+    fragments: tuple = field(default_factory=tuple)  # non-empty => fragment search
+
+    def to_json(self):
+        if self.fragments:
+            return {"type": "fragment", "values": list(self.fragments),
+                    "caseSensitive": self.case_sensitive}
+        t = "contains" if self.case_sensitive else "insensitive_contains"
+        return {"type": t, "value": self.value}
+
+    @staticmethod
+    def from_json(d):
+        if d["type"] == "fragment":
+            return SearchQueryContains("", bool(d.get("caseSensitive", False)),
+                                       tuple(d["values"]))
+        return SearchQueryContains(d["value"], d["type"] == "contains")
+
+
+@register("query", "search")
+@dataclass(frozen=True)
+class SearchQuerySpec(QuerySpec):
+    """Dimension-value search (reference SearchQuerySpec, SURVEY.md §3.3)."""
+
+    query_type_name = "search"
+
+    search_dimensions: tuple = field(default_factory=tuple)  # () = all dims
+    query: SearchQueryContains = None  # type: ignore[assignment]
+    limit: int = 1000
+    sort: str = "lexicographic"  # lexicographic | alphanumeric | strlen
+
+    def to_json(self):
+        d = {"queryType": "search", "type": "search"}
+        self._common_json(d)
+        if self.search_dimensions:
+            d["searchDimensions"] = list(self.search_dimensions)
+        d["query"] = self.query.to_json()
+        d["limit"] = self.limit
+        d["sort"] = {"type": self.sort}
+        return d
+
+    @staticmethod
+    def from_json(d):
+        sort = d.get("sort", "lexicographic")
+        if isinstance(sort, dict):
+            sort = sort.get("type", "lexicographic")
+        return SearchQuerySpec(
+            search_dimensions=tuple(d.get("searchDimensions", [])),
+            query=SearchQueryContains.from_json(d["query"]),
+            limit=int(d.get("limit", 1000)),
+            sort=sort,
+            **QuerySpec._common_kwargs(d),
+        )
+
+
+@register("query", "segmentMetadata")
+@dataclass(frozen=True)
+class SegmentMetadataQuerySpec(QuerySpec):
+    """Per-column type/cardinality/size metadata (reference: populates the
+    metadata cache and cost model, SURVEY.md §4.1)."""
+
+    query_type_name = "segmentMetadata"
+
+    to_include: tuple = field(default_factory=tuple)  # () = all columns
+    merge: bool = True
+
+    def to_json(self):
+        d = {"queryType": "segmentMetadata", "type": "segmentMetadata"}
+        self._common_json(d)
+        if self.to_include:
+            d["toInclude"] = {"type": "list", "columns": list(self.to_include)}
+        d["merge"] = self.merge
+        return d
+
+    @staticmethod
+    def from_json(d):
+        inc = d.get("toInclude", {})
+        return SegmentMetadataQuerySpec(
+            to_include=tuple(inc.get("columns", [])) if isinstance(inc, dict) else (),
+            merge=bool(d.get("merge", True)),
+            **QuerySpec._common_kwargs(d),
+        )
+
+
+@register("query", "timeBoundary")
+@dataclass(frozen=True)
+class TimeBoundaryQuerySpec(QuerySpec):
+    query_type_name = "timeBoundary"
+
+    bound: str | None = None  # None | minTime | maxTime
+
+    def to_json(self):
+        d = {"queryType": "timeBoundary", "type": "timeBoundary"}
+        self._common_json(d)
+        if self.bound:
+            d["bound"] = self.bound
+        return d
+
+    @staticmethod
+    def from_json(d):
+        return TimeBoundaryQuerySpec(
+            bound=d.get("bound"),
+            **QuerySpec._common_kwargs(d),
+        )

@@ -1,0 +1,52 @@
+"""JSON (de)serialization registry for the polymorphic IR spec classes.
+
+The reference used json4s formats per spec class (SURVEY.md §3.6
+"Serialization"). Here every IR dataclass implements ``to_json`` and
+registers a ``from_json`` under a (kind, type-tag) key, mirroring Druid's
+``{"type": ...}`` polymorphic JSON.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+_REGISTRY: dict[tuple[str, str], Callable[[dict], Any]] = {}
+
+
+def register(kind: str, type_tag: str):
+    """Class decorator: register cls.from_json for (kind, type_tag)."""
+
+    def deco(cls):
+        _REGISTRY[(kind, type_tag)] = cls.from_json
+        cls._serde_kind = kind
+        cls._serde_type = type_tag
+        return cls
+
+    return deco
+
+
+def from_json(kind: str, d: dict | None):
+    if d is None:
+        return None
+    t = d.get("type")
+    key = (kind, t)
+    if key not in _REGISTRY:
+        raise ValueError(f"unknown {kind} type {t!r} (known: "
+                         f"{sorted(t2 for k2, t2 in _REGISTRY if k2 == kind)})")
+    return _REGISTRY[key](d)
+
+
+def to_json(obj) -> Any:
+    if obj is None:
+        return None
+    return obj.to_json()
+
+
+def query_from_json(d: dict):
+    """Entry point for raw-IR passthrough (reference: `ON DRUID DATASOURCE ds
+    EXECUTE QUERY '<json>'`, SURVEY.md §4.5). Accepts Druid's "queryType"
+    tag as well as our canonical "type"."""
+    if "type" not in d and "queryType" in d:
+        d = dict(d)
+        d["type"] = d["queryType"]
+    return from_json("query", d)

@@ -1,0 +1,190 @@
+"""Time utilities: ISO-8601 parsing, epoch-millis math, calendar bucketing.
+
+The reference delegates granularity math to Druid + joda-time (SURVEY.md
+§3.3 "Granularity"). Here all calendar-aware work happens host-side: we
+compute explicit bucket *boundary arrays* over the queried time range, and
+device kernels bucket timestamps with a vectorized searchsorted. Uniform
+(sub-day) granularities use pure integer arithmetic instead.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import re
+from zoneinfo import ZoneInfo
+
+UTC = _dt.timezone.utc
+
+MILLIS_SECOND = 1000
+MILLIS_MINUTE = 60 * MILLIS_SECOND
+MILLIS_HOUR = 60 * MILLIS_MINUTE
+MILLIS_DAY = 24 * MILLIS_HOUR
+
+_PERIOD_RE = re.compile(
+    r"^P(?:(?P<years>\d+)Y)?(?:(?P<months>\d+)M)?(?:(?P<weeks>\d+)W)?"
+    r"(?:(?P<days>\d+)D)?"
+    r"(?:T(?:(?P<hours>\d+)H)?(?:(?P<minutes>\d+)M)?(?:(?P<seconds>\d+)S)?)?$"
+)
+
+
+def parse_period(period: str) -> dict:
+    """Parse an ISO-8601 period string (P1D, PT1H, P3M, ...) to components."""
+    m = _PERIOD_RE.match(period)
+    if not m or period in ("P", "PT"):
+        raise ValueError(f"invalid ISO-8601 period: {period!r}")
+    parts = {k: int(v) for k, v in m.groupdict().items() if v}
+    if not parts or not any(parts.values()):
+        raise ValueError(f"empty/zero ISO-8601 period: {period!r}")
+    return parts
+
+
+def period_is_uniform(period: str) -> bool:
+    """True if the period is a fixed number of millis (no months/years).
+
+    Weeks/days are treated as uniform; DST shifts for day-granularity in a
+    DST-observing timezone are handled by the boundary-array path, which the
+    caller selects when tz is not fixed-offset (see calendar_boundaries).
+    """
+    parts = parse_period(period)
+    return not (parts.get("years") or parts.get("months"))
+
+
+def period_millis(period: str) -> int:
+    """Fixed millis for a uniform period. Raises for calendar periods."""
+    parts = parse_period(period)
+    if parts.get("years") or parts.get("months"):
+        raise ValueError(f"period {period!r} is not a fixed duration")
+    return (
+        parts.get("weeks", 0) * 7 * MILLIS_DAY
+        + parts.get("days", 0) * MILLIS_DAY
+        + parts.get("hours", 0) * MILLIS_HOUR
+        + parts.get("minutes", 0) * MILLIS_MINUTE
+        + parts.get("seconds", 0) * MILLIS_SECOND
+    )
+
+
+def parse_iso_datetime(s: str) -> int:
+    """ISO-8601 datetime (or date) string -> epoch millis (UTC)."""
+    s = s.strip()
+    if s.endswith("Z"):
+        s = s[:-1] + "+00:00"
+    d = _dt.datetime.fromisoformat(s)
+    if d.tzinfo is None:
+        d = d.replace(tzinfo=UTC)
+    return int(d.timestamp() * 1000)
+
+
+def millis_to_iso(ms: int) -> str:
+    d = _dt.datetime.fromtimestamp(ms / 1000.0, tz=UTC)
+    return d.strftime("%Y-%m-%dT%H:%M:%S.") + f"{ms % 1000:03d}Z"
+
+
+def date_to_millis(year: int, month: int = 1, day: int = 1) -> int:
+    return int(_dt.datetime(year, month, day, tzinfo=UTC).timestamp() * 1000)
+
+
+def _advance(d: _dt.datetime, parts: dict) -> _dt.datetime:
+    """Advance a tz-aware datetime by one ISO period, calendar-correct."""
+    y = d.year
+    mo = d.month
+    y += parts.get("years", 0)
+    mo += parts.get("months", 0)
+    y += (mo - 1) // 12
+    mo = (mo - 1) % 12 + 1
+    day = min(d.day, _days_in_month(y, mo))
+    d2 = d.replace(year=y, month=mo, day=day)
+    delta = _dt.timedelta(
+        weeks=parts.get("weeks", 0),
+        days=parts.get("days", 0),
+        hours=parts.get("hours", 0),
+        minutes=parts.get("minutes", 0),
+        seconds=parts.get("seconds", 0),
+    )
+    if delta:
+        # wall-clock advance: convert through naive local time so that
+        # day-steps land on the same local wall time across DST shifts
+        naive = d2.replace(tzinfo=None) + delta
+        d2 = naive.replace(tzinfo=d2.tzinfo)
+    return d2
+
+
+def _days_in_month(year: int, month: int) -> int:
+    if month == 12:
+        nxt = _dt.date(year + 1, 1, 1)
+    else:
+        nxt = _dt.date(year, month + 1, 1)
+    return (nxt - _dt.date(year, month, 1)).days
+
+
+def _floor_to_period_start(d: _dt.datetime, parts: dict) -> _dt.datetime:
+    """Floor a local datetime to the natural start of its period bucket."""
+    if parts.get("years"):
+        n = parts["years"]
+        y = d.year - (d.year % n)
+        return d.replace(year=y, month=1, day=1, hour=0, minute=0, second=0, microsecond=0)
+    if parts.get("months"):
+        n = parts["months"]
+        mo0 = (d.month - 1) - ((d.month - 1) % n)
+        return d.replace(month=mo0 + 1, day=1, hour=0, minute=0, second=0, microsecond=0)
+    if parts.get("weeks"):
+        # ISO week: floor to Monday, aligned modulo n weeks from the epoch
+        # Monday (1970-01-05) so PnW bucket starts don't depend on t_min
+        n = parts["weeks"]
+        start = d.replace(hour=0, minute=0, second=0, microsecond=0)
+        start = start - _dt.timedelta(days=start.weekday())
+        week_idx = (start.date() - _dt.date(1970, 1, 5)).days // 7
+        return start - _dt.timedelta(weeks=week_idx % n)
+    if parts.get("days"):
+        return d.replace(hour=0, minute=0, second=0, microsecond=0)
+    if parts.get("hours"):
+        n = parts["hours"]
+        return d.replace(hour=d.hour - d.hour % n, minute=0, second=0, microsecond=0)
+    if parts.get("minutes"):
+        n = parts["minutes"]
+        return d.replace(minute=d.minute - d.minute % n, second=0, microsecond=0)
+    if parts.get("seconds"):
+        n = parts["seconds"]
+        return d.replace(second=d.second - d.second % n, microsecond=0)
+    return d
+
+
+def calendar_boundaries(period: str, tz: str, t_min_ms: int, t_max_ms: int) -> list[int]:
+    """Bucket boundaries (epoch millis, ascending) covering [t_min, t_max].
+
+    boundaries[i] is the inclusive start of bucket i; the list has one extra
+    trailing boundary past t_max so searchsorted(...)-1 is always valid for
+    timestamps in range. Calendar-correct in the given IANA timezone.
+    """
+    if t_max_ms < t_min_ms:
+        return [t_min_ms, t_min_ms + 1]
+    parts = parse_period(period)
+    zone = ZoneInfo(tz)
+    d = _dt.datetime.fromtimestamp(t_min_ms / 1000.0, tz=zone)
+    d = _floor_to_period_start(d, parts)
+    out = []
+    if period_is_uniform(period):
+        # Fixed-duration stepping in epoch space: strictly increasing even
+        # across DST transitions (buckets are exact n-millis instants from
+        # the locally-floored start; wall-clock alignment is fixed at t_min).
+        step = period_millis(period)
+        ms = int(d.timestamp() * 1000)
+        while True:
+            out.append(ms)
+            if ms > t_max_ms:
+                break
+            ms += step
+    else:
+        guard = 0
+        while True:
+            ms = int(d.timestamp() * 1000)
+            if not out or ms > out[-1]:
+                out.append(ms)
+            if ms > t_max_ms:
+                break
+            d = _advance(d, parts)
+            guard += 1
+            if guard > 2_000_000:
+                raise ValueError(f"too many buckets for period {period!r}")
+    if len(out) < 2:
+        out.append(out[-1] + 1)
+    return out
