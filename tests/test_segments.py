@@ -1,0 +1,118 @@
+"""Segments-layer tests: dictionary encoding, blocking, pruning, metadata."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpu_olap.ir import Interval
+from tpu_olap.segments import (ColumnType, Dictionary, TIME_COLUMN,
+                               ingest_pandas)
+from tpu_olap.utils import timeutil as tu
+
+
+def make_df(n=1000, seed=0):
+    rng = np.random.default_rng(seed)
+    t0 = tu.date_to_millis(1993, 1, 1)
+    return pd.DataFrame({
+        "ts": t0 + rng.integers(0, 365 * 86_400_000, n),
+        "city": rng.choice(["amsterdam", "berlin", "chicago", None], n),
+        "qty": rng.integers(1, 50, n).astype(np.int64),
+        "price": rng.uniform(0, 100, n),
+    })
+
+
+def test_dictionary_build_roundtrip():
+    d, codes = Dictionary.build(np.array(["b", "a", None, "b", "c"], dtype=object))
+    assert list(d.values) == ["a", "b", "c"]
+    assert codes.tolist() == [2, 1, 0, 2, 3]
+    assert d.decode(codes).tolist() == ["b", "a", None, "b", "c"]
+    assert d.id_of(None) == 0 and d.id_of("a") == 1 and d.id_of("zz") == -1
+
+
+def test_dictionary_predicates():
+    d, _ = Dictionary.build(np.array(["apple", "banana", "cherry"], dtype=object))
+    lo, hi = d.bound_code_range("b", None, False, False)
+    assert (lo, hi) == (2, 3)  # banana..cherry
+    lo, hi = d.bound_code_range("banana", "banana", False, False)
+    assert (lo, hi) == (2, 2)
+    lo, hi = d.bound_code_range("banana", "banana", True, False)
+    assert lo > hi  # empty
+    t = d.regex_table("an")
+    assert t.tolist() == [False, False, True, False]
+    t = d.like_table("%err%")
+    assert t.tolist() == [False, False, False, True]
+    t = d.in_table(["apple", "zz", None])
+    assert t.tolist() == [True, True, False, False]  # note: None -> id 0
+
+
+def test_ingest_blocks_and_padding():
+    df = make_df(1000)
+    ts = ingest_pandas("t", df, time_column="ts", block_rows=256)
+    assert ts.num_rows == 1000
+    assert len(ts.segments) == 4
+    assert ts.segments[-1].meta.n_valid == 1000 - 3 * 256
+    assert ts.schema["city"] is ColumnType.STRING
+    assert ts.schema["qty"] is ColumnType.LONG
+    assert ts.schema["price"] is ColumnType.DOUBLE
+    # time-sorted across segment boundaries
+    last = None
+    for s in ts.segments:
+        t = s.columns[TIME_COLUMN][:s.meta.n_valid]
+        assert (np.diff(t) >= 0).all()
+        if last is not None:
+            assert t[0] >= last
+        last = t[-1]
+    # decode round-trip preserves multiset of values
+    d = ts.dictionaries["city"]
+    decoded = np.concatenate([
+        d.decode(s.columns["city"][:s.meta.n_valid]) for s in ts.segments])
+    left = pd.Series(decoded).fillna("~").value_counts()
+    right = df["city"].fillna("~").value_counts()
+    assert left.sort_index().tolist() == right.sort_index().tolist()
+
+
+def test_prune_by_interval_and_bounds():
+    df = make_df(1000)
+    ts = ingest_pandas("t", df, time_column="ts", block_rows=256)
+    t0, t1 = ts.time_boundary
+    # narrow interval touching only the first block
+    first_max = ts.segments[0].meta.time_max
+    pruned = ts.prune([Interval(t0, first_max + 1)])
+    assert len(pruned) < 4
+    # impossible numeric bound prunes everything
+    pruned = ts.prune([], numeric_bounds={"qty": (1000, None)})
+    assert pruned == []
+    pruned = ts.prune([], numeric_bounds={"qty": (None, 49)})
+    assert len(pruned) == 4
+
+
+def test_column_metadata():
+    ts = ingest_pandas("t", make_df(500), time_column="ts")
+    md = ts.column_metadata()
+    assert md["city"]["cardinality"] == 3
+    assert md["qty"]["min"] >= 1 and md["qty"]["max"] <= 49
+    assert md[TIME_COLUMN]["type"] == "LONG"
+    assert ts.cardinality("qty") is None
+
+
+def test_ingest_without_time_column():
+    df = make_df(100).drop(columns=["ts"])
+    ts = ingest_pandas("t", df)
+    assert ts.time_boundary == (0, 0)
+    assert ts.num_rows == 100
+
+
+def test_nulls_in_numeric():
+    df = pd.DataFrame({"x": [1.0, np.nan, 3.0], "k": ["a", "b", "a"]})
+    ts = ingest_pandas("t", df)
+    s = ts.segments[0]
+    assert "x" in s.null_masks
+    assert s.null_masks["x"][:3].tolist() == [False, True, False]
+
+
+def test_unsupported_type_raises():
+    import pyarrow as pa
+    from tpu_olap.segments import ingest_arrow
+    t = pa.table({"a": pa.array([[1, 2], [3]], type=pa.list_(pa.int64()))})
+    with pytest.raises(TypeError, match="unsupported column type"):
+        ingest_arrow("t", t)

@@ -77,9 +77,12 @@ class SubstringExtractionFn(ExtractionFunctionSpec):
 @register("extractionFn", "lookup")
 @dataclass(frozen=True)
 class LookupExtractionFn(ExtractionFunctionSpec):
-    lookup: tuple  # tuple of (key, value) pairs
+    lookup: tuple  # tuple of (key, value) pairs, canonicalized sorted
     retain_missing_value: bool = False
     replace_missing_value: str | None = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "lookup", tuple(sorted(self.lookup)))
 
     def to_json(self):
         return {"type": "lookup",

@@ -151,12 +151,14 @@ class TopNQuerySpec(QuerySpec):
     granularity: Granularity = field(default_factory=AllGranularity)
     aggregations: tuple = field(default_factory=tuple)
     post_aggregations: tuple = field(default_factory=tuple)
+    inverted: bool = False  # bottom-N (Druid {"type": "inverted"} metric)
 
     def to_json(self):
         d = {"queryType": "topN", "type": "topN"}
         self._common_json(d)
         d["dimension"] = self.dimension.to_json()
-        d["metric"] = self.metric
+        d["metric"] = ({"type": "inverted", "metric": self.metric}
+                       if self.inverted else self.metric)
         d["threshold"] = self.threshold
         d["granularity"] = self.granularity.to_json()
         d["aggregations"] = [a.to_json() for a in self.aggregations]
@@ -167,11 +169,21 @@ class TopNQuerySpec(QuerySpec):
     @staticmethod
     def from_json(d):
         metric = d["metric"]
+        inverted = False
         if isinstance(metric, dict):
-            metric = metric.get("metric", metric.get("fieldName", ""))
+            mtype = metric.get("type", "numeric")
+            if mtype == "inverted":
+                inverted = True
+                inner = metric.get("metric")
+                metric = inner.get("metric") if isinstance(inner, dict) else inner
+            elif mtype == "numeric":
+                metric = metric.get("metric", metric.get("fieldName", ""))
+            else:
+                raise ValueError(f"unsupported topN metric spec type {mtype!r}")
         return TopNQuerySpec(
             dimension=dimension_from_json(d["dimension"]),
             metric=metric,
+            inverted=inverted,
             threshold=int(d["threshold"]),
             granularity=granularity_from_json(d.get("granularity")),
             aggregations=tuple(aggregation_from_json(a)

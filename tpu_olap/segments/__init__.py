@@ -1,0 +1,20 @@
+"""Columnar segment storage — the in-tree replacement for Druid's external
+segment engine (SURVEY.md §3.7, §8.2 step 1).
+
+Data model: a table is a set of fixed-size row *blocks* ("segments"), sorted
+by the time column, with string dimensions dictionary-encoded against a
+*global sorted dictionary* (id 0 reserved for null; ids 1..n are the sorted
+distinct values — so per-value predicates become code-space predicates and
+cross-segment group-by merges need no dictionary reconciliation). Numeric
+metrics are stored in their natural width on host; the executor picks device
+dtypes. A manifest records per-segment time ranges and column min/max for
+interval/zone pruning (SURVEY.md §3.5 P4).
+"""
+
+from tpu_olap.segments.dictionary import Dictionary  # noqa: F401
+from tpu_olap.segments.segment import (  # noqa: F401
+    ColumnType, Segment, SegmentMeta, TableSegments, TIME_COLUMN,
+)
+from tpu_olap.segments.ingest import (  # noqa: F401
+    ingest_arrow, ingest_parquet, ingest_pandas,
+)

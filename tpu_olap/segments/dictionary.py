@@ -1,0 +1,132 @@
+"""Global sorted dictionary for string dimensions.
+
+Id space: 0 = null, 1..n = sorted distinct values. Sorted order makes
+lexicographic bound filters pure code-range comparisons, and a *global*
+(not per-segment) dictionary makes group-by codes directly mergeable across
+segments and chips — the TPU-first choice that replaces Druid's per-segment
+dictionaries + broker-side string merge (SURVEY.md §3.7).
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+NULL_ID = 0
+
+
+class Dictionary:
+    __slots__ = ("values", "_index")
+
+    def __init__(self, values: np.ndarray):
+        """values: sorted unique string array (no nulls)."""
+        self.values = values
+        self._index = None  # lazy value -> id dict
+
+    @staticmethod
+    def build(arr) -> tuple["Dictionary", np.ndarray]:
+        """Build from a string array (object/str dtype, None/NaN = null).
+
+        Returns (dictionary, codes int32) with 0 for nulls.
+        """
+        import pandas as pd
+        a = np.asarray(arr, dtype=object)
+        mask = np.asarray(pd.isna(a), dtype=bool)
+        clean = np.where(mask, "", a).astype(str)
+        uniq, inv = np.unique(clean, return_inverse=True)
+        # drop the "" placeholder from the dict if it only came from nulls
+        has_empty_real = bool((~mask & (clean == "")).any())
+        if not has_empty_real and (mask.any() and "" in uniq):
+            keep = uniq != ""
+            remap = np.cumsum(keep) - 1  # old idx -> new idx (for kept)
+            codes = np.where(mask, -1, remap[inv]).astype(np.int64)
+            uniq = uniq[keep]
+        else:
+            codes = np.where(mask, -1, inv).astype(np.int64)
+        return Dictionary(uniq.astype(str)), (codes + 1).astype(np.int32)
+
+    @property
+    def size(self) -> int:
+        """Number of real values (excluding the null slot)."""
+        return len(self.values)
+
+    @property
+    def cardinality(self) -> int:
+        return len(self.values)
+
+    def id_of(self, value: str | None) -> int:
+        """Id for a value; 0 for null; -1 if the value is absent."""
+        if value is None:
+            return NULL_ID
+        if self._index is None:
+            self._index = {v: i + 1 for i, v in enumerate(self.values)}
+        return self._index.get(str(value), -1)
+
+    def decode(self, codes: np.ndarray) -> np.ndarray:
+        """codes -> object array of strings (None for null id)."""
+        out = np.empty(len(codes), dtype=object)
+        nz = codes > 0
+        out[nz] = self.values[codes[nz] - 1]
+        out[~nz] = None
+        return out
+
+    # ---- predicate compilation: value-space -> id-space ------------------
+
+    def bound_code_range(self, lower, upper, lower_strict: bool,
+                         upper_strict: bool) -> tuple[int, int]:
+        """Lexicographic bound -> inclusive id range [lo, hi] (may be empty).
+
+        Null (id 0) never matches a bound.
+        """
+        lo = 1
+        hi = self.size
+        if lower is not None:
+            side = "right" if lower_strict else "left"
+            lo = int(np.searchsorted(self.values, str(lower), side=side)) + 1
+        if upper is not None:
+            side = "left" if upper_strict else "right"
+            hi = int(np.searchsorted(self.values, str(upper), side=side))
+        return lo, hi
+
+    def predicate_table(self, fn) -> np.ndarray:
+        """bool[size+1] lookup table: table[id] = fn(value); table[0]=False.
+
+        This is how regex/like/in/search predicates lower: O(|dict|) host
+        work once per query, then a single gather on device
+        (tpu_olap.kernels.filtereval).
+        """
+        t = np.zeros(self.size + 1, dtype=bool)
+        for i, v in enumerate(self.values):
+            if fn(v):
+                t[i + 1] = True
+        return t
+
+    def regex_table(self, pattern: str) -> np.ndarray:
+        rx = re.compile(pattern)
+        return self.predicate_table(lambda v: rx.search(v) is not None)
+
+    def like_table(self, pattern: str) -> np.ndarray:
+        rx = re.compile(_like_to_regex(pattern))
+        return self.predicate_table(lambda v: rx.fullmatch(v) is not None)
+
+    def in_table(self, values) -> np.ndarray:
+        t = np.zeros(self.size + 1, dtype=bool)
+        for v in values:
+            i = self.id_of(v)
+            if i >= 0:
+                t[i] = True
+        return t
+
+
+def _like_to_regex(pattern: str) -> str:
+    """SQL LIKE pattern (% _) -> anchored regex, escaping everything else."""
+    out = []
+    for ch in pattern:
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return "".join(out)

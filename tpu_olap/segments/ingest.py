@@ -1,0 +1,166 @@
+"""Parquet/Arrow/pandas -> TableSegments.
+
+The analog of the reference's L0→L1 data path: the raw fact table Druid
+would have indexed is ingested directly into HBM-ready columnar blocks
+(BASELINE.json:5 "streams Parquet→HBM"). Host-side work: type mapping,
+time-sort, global dictionary build, fixed-size blocking with padding.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpu_olap.segments.dictionary import Dictionary
+from tpu_olap.segments.segment import (ColumnType, Segment, SegmentMeta,
+                                       TableSegments, TIME_COLUMN, _scalar)
+
+DEFAULT_BLOCK_ROWS = 1 << 16
+
+
+def ingest_parquet(name: str, path: str, time_column: str | None = None,
+                   block_rows: int = DEFAULT_BLOCK_ROWS,
+                   columns=None) -> TableSegments:
+    import pyarrow.parquet as pq
+    table = pq.read_table(path, columns=list(columns) if columns else None)
+    return ingest_arrow(name, table, time_column, block_rows)
+
+
+def ingest_pandas(name: str, df, time_column: str | None = None,
+                  block_rows: int = DEFAULT_BLOCK_ROWS) -> TableSegments:
+    import pyarrow as pa
+    return ingest_arrow(name, pa.Table.from_pandas(df, preserve_index=False),
+                        time_column, block_rows)
+
+
+def ingest_arrow(name: str, table, time_column: str | None = None,
+                 block_rows: int = DEFAULT_BLOCK_ROWS) -> TableSegments:
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    schema: dict = {}
+    raw: dict = {}      # col -> numpy array (pre-encoding)
+    nulls: dict = {}    # col -> bool mask
+
+    # ---- time column -> __time (epoch millis int64) ----------------------
+    n = table.num_rows
+    if time_column is not None:
+        tcol = table.column(time_column)
+        if tcol.null_count:
+            raise ValueError(
+                f"time column {time_column!r} contains nulls; a non-null "
+                "time value is required per row (like Druid's __time)")
+        if pa.types.is_timestamp(tcol.type):
+            tms = pc.cast(tcol, pa.timestamp("ms"))
+            tvals = tms.combine_chunks().to_numpy(zero_copy_only=False)
+            tvals = tvals.astype("datetime64[ms]").astype(np.int64)
+        elif pa.types.is_date(tcol.type):
+            tvals = (tcol.combine_chunks().to_numpy(zero_copy_only=False)
+                     .astype("datetime64[ms]").astype(np.int64))
+        else:  # already numeric epoch millis
+            tvals = tcol.combine_chunks().to_numpy(zero_copy_only=False) \
+                .astype(np.int64)
+    else:
+        tvals = np.zeros(n, dtype=np.int64)
+    raw[TIME_COLUMN] = tvals
+    schema[TIME_COLUMN] = ColumnType.LONG
+
+    # ---- other columns ---------------------------------------------------
+    for fld in table.schema:
+        col = fld.name
+        if col == time_column or col == TIME_COLUMN:
+            continue
+        arr = table.column(col).combine_chunks()
+        t = fld.type
+        if pa.types.is_dictionary(t):
+            arr = pc.cast(arr, t.value_type)
+            t = t.value_type
+        null_mask = np.asarray(arr.is_null())
+        if pa.types.is_null(t):  # all-null column: treat as all-null STRING
+            schema[col] = ColumnType.STRING
+            raw[col] = np.full(n, None, dtype=object)
+        elif pa.types.is_string(t) or pa.types.is_large_string(t):
+            schema[col] = ColumnType.STRING
+            raw[col] = arr.to_pandas().to_numpy(dtype=object)
+        elif pa.types.is_floating(t):
+            schema[col] = ColumnType.DOUBLE
+            v = arr.to_numpy(zero_copy_only=False).astype(np.float64)
+            # genuine NaN values (valid Arrow values) fold into the null
+            # mask, matching SQL NULL semantics and keeping kernels NaN-free
+            null_mask = null_mask | np.isnan(v)
+            raw[col] = np.nan_to_num(v)
+            if null_mask.any():
+                nulls[col] = null_mask
+        elif pa.types.is_integer(t) or pa.types.is_boolean(t):
+            schema[col] = ColumnType.LONG
+            v = arr.to_numpy(zero_copy_only=False)
+            if null_mask.any():
+                v = np.where(null_mask, 0, v)
+                nulls[col] = null_mask
+            raw[col] = v.astype(np.int64)
+        elif pa.types.is_timestamp(t) or pa.types.is_date(t):
+            schema[col] = ColumnType.LONG
+            raw[col] = (pc.cast(arr, pa.timestamp("ms"))
+                        .to_numpy(zero_copy_only=False)
+                        .astype("datetime64[ms]").astype(np.int64))
+            if null_mask.any():
+                nulls[col] = null_mask
+        elif pa.types.is_decimal(t):
+            schema[col] = ColumnType.DOUBLE
+            raw[col] = np.array([float(x) if x is not None else 0.0
+                                 for x in arr.to_pylist()], dtype=np.float64)
+            if null_mask.any():
+                nulls[col] = null_mask
+        else:
+            raise TypeError(f"unsupported column type {t} for {col!r}")
+
+    # ---- sort by time (Druid segments are time-ordered) ------------------
+    order = np.argsort(raw[TIME_COLUMN], kind="stable")
+    if not np.array_equal(order, np.arange(n)):
+        raw = {c: v[order] for c, v in raw.items()}
+        nulls = {c: v[order] for c, v in nulls.items()}
+
+    # ---- global dictionaries + encoding ----------------------------------
+    dictionaries: dict = {}
+    encoded: dict = {}
+    for col, typ in schema.items():
+        if typ is ColumnType.STRING:
+            d, codes = Dictionary.build(raw[col])
+            dictionaries[col] = d
+            encoded[col] = codes
+        else:
+            encoded[col] = raw[col]
+
+    # ---- fixed-size blocking with padding --------------------------------
+    segments = []
+    n_blocks = max(1, -(-n // block_rows))
+    for b in range(n_blocks):
+        lo, hi = b * block_rows, min((b + 1) * block_rows, n)
+        nv = hi - lo
+        cols, masks = {}, {}
+        for col, v in encoded.items():
+            block = np.zeros(block_rows, dtype=v.dtype)
+            block[:nv] = v[lo:hi]
+            cols[col] = block
+        for col, m in nulls.items():
+            block = np.zeros(block_rows, dtype=bool)
+            block[:nv] = m[lo:hi]
+            masks[col] = block
+        t = cols[TIME_COLUMN][:nv]
+        meta = SegmentMeta(
+            segment_id=b, n_valid=nv,
+            time_min=int(t.min()) if nv else 0,
+            time_max=int(t.max()) if nv else 0,
+        )
+        for col, typ in schema.items():
+            if typ is not ColumnType.STRING and nv:
+                cv = cols[col][:nv]
+                nm = masks.get(col)
+                if nm is not None and nm[:nv].all():
+                    continue
+                if nm is not None and nm[:nv].any():
+                    cv = cv[~nm[:nv]]
+                meta.column_min[col] = _scalar(cv.min())
+                meta.column_max[col] = _scalar(cv.max())
+        segments.append(Segment(meta, cols, masks))
+
+    return TableSegments(name, schema, dictionaries, segments, block_rows)

@@ -1,0 +1,143 @@
+"""Segment blocks, per-segment metadata, and the table-level container.
+
+The analog of Druid's segment files + the reference's DruidDataSource/
+SegmentInfo metadata model (SURVEY.md §3.4): fixed-size row blocks sorted by
+time, a manifest of per-segment [time_min, time_max] + column stats for
+pruning, and table-level schema/dictionaries.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from tpu_olap.segments.dictionary import Dictionary
+
+TIME_COLUMN = "__time"
+
+
+class ColumnType(enum.Enum):
+    STRING = "STRING"  # dict-encoded int32 codes (0 = null)
+    LONG = "LONG"      # int64
+    DOUBLE = "DOUBLE"  # float64
+
+    @property
+    def is_dim(self):
+        return self is ColumnType.STRING
+
+
+@dataclass
+class SegmentMeta:
+    segment_id: int
+    n_valid: int              # rows 0..n_valid-1 are real; rest is padding
+    time_min: int
+    time_max: int
+    column_min: dict = field(default_factory=dict)  # numeric cols only
+    column_max: dict = field(default_factory=dict)
+
+    def to_json(self):
+        return {"segmentId": self.segment_id, "numRows": self.n_valid,
+                "timeMin": self.time_min, "timeMax": self.time_max,
+                "columnMin": dict(self.column_min),
+                "columnMax": dict(self.column_max)}
+
+
+@dataclass
+class Segment:
+    """One fixed-size block of rows. All column arrays have block_rows
+    entries; rows >= meta.n_valid are padding (never observable: every
+    kernel threads a row-validity mask)."""
+
+    meta: SegmentMeta
+    columns: dict  # name -> np.ndarray (int32 codes | int64 | float64)
+    null_masks: dict  # name -> bool array, only for numeric cols with nulls
+
+    @property
+    def block_rows(self) -> int:
+        return len(next(iter(self.columns.values())))
+
+
+class TableSegments:
+    """All segments of one registered datasource + shared metadata."""
+
+    def __init__(self, name: str, schema: dict, dictionaries: dict,
+                 segments: list, block_rows: int):
+        self.name = name
+        self.schema = schema            # col -> ColumnType (incl. __time)
+        self.dictionaries = dictionaries  # col -> Dictionary (STRING cols)
+        self.segments = segments        # list[Segment], time-ordered
+        self.block_rows = block_rows
+
+    # ---- metadata (feeds SegmentMetadata queries + cost model) -----------
+
+    @property
+    def num_rows(self) -> int:
+        return sum(s.meta.n_valid for s in self.segments)
+
+    @property
+    def time_boundary(self) -> tuple[int, int]:
+        if not self.segments:
+            return (0, 0)
+        return (min(s.meta.time_min for s in self.segments),
+                max(s.meta.time_max for s in self.segments))
+
+    def cardinality(self, col: str) -> int | None:
+        d = self.dictionaries.get(col)
+        return d.cardinality if d is not None else None
+
+    def column_metadata(self, cols=None) -> dict:
+        """Per-column type/cardinality/size — the SegmentMetadata query body
+        (reference: populates DruidMetadataCache + cost model, §4.1)."""
+        out = {}
+        for col, typ in self.schema.items():
+            if cols and col not in cols:
+                continue
+            entry = {"type": typ.value, "numRows": self.num_rows}
+            d = self.dictionaries.get(col)
+            if d is not None:
+                entry["cardinality"] = d.cardinality
+                entry["size"] = int(sum(len(v) for v in d.values))
+            else:
+                arrs = [s.columns[col][:s.meta.n_valid] for s in self.segments
+                        if s.meta.n_valid]
+                entry["size"] = int(sum(a.nbytes for a in arrs))
+                if arrs:
+                    entry["min"] = _scalar(min(a.min() for a in arrs))
+                    entry["max"] = _scalar(max(a.max() for a in arrs))
+            out[col] = entry
+        return out
+
+    # ---- pruning ---------------------------------------------------------
+
+    def prune(self, intervals, numeric_bounds=None) -> list:
+        """Segments overlapping any query interval and (optionally) any
+        per-column numeric [lo, hi] requirement (SURVEY.md §3.5 P4)."""
+        out = []
+        for s in self.segments:
+            if intervals and not any(
+                    iv.overlaps(s.meta.time_min, s.meta.time_max + 1)
+                    for iv in intervals):
+                continue
+            if numeric_bounds and not _bounds_admit(s.meta, numeric_bounds):
+                continue
+            out.append(s)
+        return out
+
+
+def _bounds_admit(meta: SegmentMeta, numeric_bounds: dict) -> bool:
+    for col, (lo, hi) in numeric_bounds.items():
+        cmin = meta.column_min.get(col)
+        cmax = meta.column_max.get(col)
+        if cmin is None or cmax is None:
+            continue
+        if lo is not None and cmax < lo:
+            return False
+        if hi is not None and cmin > hi:
+            return False
+    return True
+
+
+def _scalar(x):
+    return x.item() if isinstance(x, np.generic) else x

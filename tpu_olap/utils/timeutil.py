@@ -162,10 +162,11 @@ def calendar_boundaries(period: str, tz: str, t_min_ms: int, t_max_ms: int) -> l
     d = _dt.datetime.fromtimestamp(t_min_ms / 1000.0, tz=zone)
     d = _floor_to_period_start(d, parts)
     out = []
-    if period_is_uniform(period):
-        # Fixed-duration stepping in epoch space: strictly increasing even
-        # across DST transitions (buckets are exact n-millis instants from
-        # the locally-floored start; wall-clock alignment is fixed at t_min).
+    if period_is_uniform(period) and tz == "UTC":
+        # Fixed-duration stepping in epoch space. Only valid in UTC: in a
+        # DST-observing tz, day/week buckets must follow local midnight, so
+        # they take the wall-clock _advance path below (which dedupes the
+        # repeated instant at spring-forward).
         step = period_millis(period)
         ms = int(d.timestamp() * 1000)
         while True:
