@@ -1,0 +1,312 @@
+"""Kernel golden tests vs numpy/pandas oracle (SURVEY.md §5 implication #2).
+
+Each kernel runs on both the numpy path and the jitted jax path; results
+must agree with each other and with a pandas oracle.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpu_olap.ir import (AndFilter, BoundFilter, CountAggregation,
+                         ExpressionFilter, InFilter, LikeFilter, NotFilter,
+                         OrFilter, RegexFilter, SelectorFilter,
+                         SumAggregation, MinAggregation, MaxAggregation,
+                         CardinalityAggregation, ThetaSketchAggregation,
+                         FilteredAggregation, PeriodGranularity, parse_expr)
+from tpu_olap.kernels import (ConstPool, compile_aggregations, compile_filter,
+                              compile_granularity, group_reduce,
+                              hll_estimate, top_k_groups)
+from tpu_olap.kernels.groupby import build_group_key, merge_partials
+from tpu_olap.kernels.theta import theta_estimate, theta_merge
+from tpu_olap.kernels.timebucket import compile_time_format
+from tpu_olap.segments import ingest_pandas, TIME_COLUMN
+from tpu_olap.utils import timeutil as tu
+
+jax.config.update("jax_enable_x64", True)
+
+
+def make_table(n=4000, seed=7):
+    rng = np.random.default_rng(seed)
+    t0 = tu.date_to_millis(1993, 1, 1)
+    df = pd.DataFrame({
+        "ts": t0 + rng.integers(0, 365 * 86_400_000, n),
+        "city": rng.choice(["amsterdam", "berlin", "chicago", "denver", None],
+                           n, p=[0.3, 0.3, 0.2, 0.15, 0.05]),
+        "qty": rng.integers(1, 50, n).astype(np.int64),
+        "price": np.round(rng.uniform(0, 100, n), 2),
+        "uid": rng.integers(0, 500, n).astype(np.int64),
+    })
+    ts = ingest_pandas("t", df, time_column="ts", block_rows=1 << 12)
+    # ingest time-sorts rows; align the oracle frame the same way
+    df = df.sort_values("ts", kind="stable").reset_index(drop=True)
+    return df, ts
+
+
+def flat_env(ts, xp):
+    s = ts.segments[0]
+    conv = (lambda a: a) if xp is np else jnp.asarray
+    return {
+        "cols": {c: conv(v) for c, v in s.columns.items()},
+        "nulls": {c: conv(v) for c, v in s.null_masks.items()},
+    }, conv(np.arange(s.block_rows) < s.meta.n_valid)
+
+
+DF, TS = make_table()
+
+
+def run_filter(spec, xp):
+    pool = ConstPool()
+    fn = compile_filter(spec, TS, pool,
+                        virtual_exprs={"rev": parse_expr("qty * price")})
+    env, valid = flat_env(TS, xp)
+    consts = pool.consts if xp is np else {k: jnp.asarray(v)
+                                           for k, v in pool.consts.items()}
+    mask = fn(env, consts) & valid
+    return np.asarray(mask)[:TS.segments[0].meta.n_valid]
+
+
+@pytest.mark.parametrize("xp", [np, jnp], ids=["numpy", "jax"])
+class TestFilters:
+    def test_selector(self, xp):
+        got = run_filter(SelectorFilter("city", "berlin"), xp)
+        assert (got == (DF.city == "berlin").to_numpy()).all()
+
+    def test_selector_null(self, xp):
+        got = run_filter(SelectorFilter("city", None), xp)
+        assert (got == DF.city.isna().to_numpy()).all()
+
+    def test_selector_numeric(self, xp):
+        got = run_filter(SelectorFilter("qty", 7), xp)
+        assert (got == (DF.qty == 7).to_numpy()).all()
+
+    def test_bound_numeric(self, xp):
+        got = run_filter(
+            BoundFilter("price", lower=20, upper=60, upper_strict=True,
+                        ordering="numeric"), xp)
+        assert (got == ((DF.price >= 20) & (DF.price < 60)).to_numpy()).all()
+
+    def test_bound_lexicographic(self, xp):
+        got = run_filter(BoundFilter("city", lower="b", upper="chicago"), xp)
+        want = ((DF.city >= "b") & (DF.city <= "chicago")).fillna(False)
+        assert (got == want.to_numpy()).all()
+
+    def test_in_string(self, xp):
+        got = run_filter(InFilter("city", ("denver", "berlin")), xp)
+        assert (got == DF.city.isin(["denver", "berlin"]).to_numpy()).all()
+
+    def test_in_numeric(self, xp):
+        got = run_filter(InFilter("qty", (1, 5, 7)), xp)
+        assert (got == DF.qty.isin([1, 5, 7]).to_numpy()).all()
+
+    def test_regex_like(self, xp):
+        got = run_filter(RegexFilter("city", "^.e"), xp)
+        want = DF.city.str.match(".e").fillna(False)
+        assert (got == want.to_numpy()).all()
+        got = run_filter(LikeFilter("city", "%er%"), xp)
+        want = DF.city.str.contains("er").fillna(False)
+        assert (got == want.to_numpy()).all()
+
+    def test_logical(self, xp):
+        spec = OrFilter((
+            AndFilter((SelectorFilter("city", "berlin"),
+                       BoundFilter("qty", lower=25, ordering="numeric"))),
+            NotFilter(BoundFilter("price", lower=1, ordering="numeric")),
+        ))
+        got = run_filter(spec, xp)
+        want = (((DF.city == "berlin") & (DF.qty >= 25))
+                | ~(DF.price >= 1)).to_numpy()
+        assert (got == want).all()
+
+    def test_expression_virtual(self, xp):
+        got = run_filter(ExpressionFilter(parse_expr("rev > 2000")), xp)
+        want = (DF.qty * DF.price > 2000).to_numpy()
+        assert (got == want).all()
+
+
+@pytest.mark.parametrize("xp", [np, jnp], ids=["numpy", "jax"])
+def test_group_reduce_matches_pandas(xp):
+    pool = ConstPool()
+    aggs = (
+        CountAggregation("cnt"),
+        SumAggregation("q_sum", "qty", "long"),
+        SumAggregation("p_sum", "price", "double"),
+        MinAggregation("p_min", "price", "double"),
+        MaxAggregation("q_max", "qty", "long"),
+        FilteredAggregation(SelectorFilter("city", "berlin"),
+                            SumAggregation("b_sum", "qty", "long")),
+    )
+    plans = compile_aggregations(aggs, TS, pool)
+    env, valid = flat_env(TS, xp)
+    consts = pool.consts if xp is np else {k: jnp.asarray(v)
+                                           for k, v in pool.consts.items()}
+    codes = env["cols"]["city"]
+    K = TS.dictionaries["city"].size + 1
+    key, total = build_group_key([codes], [K], xp)
+    out = group_reduce(key, valid, env, plans, total, consts)
+    out = {k: np.asarray(v) for k, v in out.items()}
+
+    g = DF.assign(city=DF.city.fillna("\0null")).groupby("city")
+    for city, sub in g:
+        cid = 0 if city == "\0null" else TS.dictionaries["city"].id_of(city)
+        assert out["_rows"][cid] == len(sub)
+        assert out["cnt"][cid] == len(sub)
+        assert out["q_sum"][cid] == sub.qty.sum()
+        assert np.isclose(out["p_sum"][cid], sub.price.sum())
+        assert np.isclose(out["p_min"][cid], sub.price.min())
+        assert out["q_max"][cid] == sub.qty.max()
+        want_b = sub.qty[sub.city == "berlin"].sum()
+        assert out["b_sum"][cid] == want_b
+
+
+@pytest.mark.parametrize("xp", [np, jnp], ids=["numpy", "jax"])
+def test_group_reduce_merge_partials_equals_whole(xp):
+    pool = ConstPool()
+    plans = compile_aggregations(
+        (SumAggregation("s", "qty", "long"), CountAggregation("c"),
+         MinAggregation("m", "price", "double")), TS, pool)
+    env, valid = flat_env(TS, xp)
+    consts = pool.consts if xp is np else {k: jnp.asarray(v)
+                                           for k, v in pool.consts.items()}
+    codes = env["cols"]["city"]
+    K = TS.dictionaries["city"].size + 1
+    key, total = build_group_key([codes], [K], xp)
+    n = TS.segments[0].meta.n_valid
+    half = (np.arange(TS.segments[0].block_rows) < n // 2)
+    half = half if xp is np else jnp.asarray(half)
+    m1 = valid & half
+    m2 = valid & ~half
+    p1 = group_reduce(key, m1, env, plans, total, consts)
+    p2 = group_reduce(key, m2, env, plans, total, consts)
+    whole = group_reduce(key, valid, env, plans, total, consts)
+    merged = merge_partials(p1, p2, plans)
+    for k in whole:
+        assert np.allclose(np.asarray(merged[k]), np.asarray(whole[k])), k
+
+
+@pytest.mark.parametrize("xp", [np, jnp], ids=["numpy", "jax"])
+def test_hll_cardinality(xp):
+    pool = ConstPool()
+    plans = compile_aggregations(
+        (CardinalityAggregation("u", ("uid",)),), TS, pool)
+    env, valid = flat_env(TS, xp)
+    consts = pool.consts if xp is np else {k: jnp.asarray(v)
+                                           for k, v in pool.consts.items()}
+    key, total = build_group_key([env["cols"]["city"]],
+                                 [TS.dictionaries["city"].size + 1], xp)
+    out = group_reduce(key, valid, env, plans, total, consts)
+    est = hll_estimate(np.asarray(out["u"]))
+    truth = DF.assign(city=DF.city.fillna("\0")).groupby("city").uid.nunique()
+    for city, want in truth.items():
+        cid = 0 if city == "\0" else TS.dictionaries["city"].id_of(city)
+        assert abs(est[cid] - want) / max(want, 1) < 0.12, (city, est[cid], want)
+
+
+@pytest.mark.parametrize("xp", [np, jnp], ids=["numpy", "jax"])
+def test_theta_exact_when_small(xp):
+    pool = ConstPool()
+    plans = compile_aggregations(
+        (ThetaSketchAggregation("t", "uid", 1024),), TS, pool)
+    env, valid = flat_env(TS, xp)
+    consts = pool.consts if xp is np else {k: jnp.asarray(v)
+                                           for k, v in pool.consts.items()}
+    key, total = build_group_key([env["cols"]["city"]],
+                                 [TS.dictionaries["city"].size + 1], xp)
+    out = group_reduce(key, valid, env, plans, total, consts)
+    est = theta_estimate(np.asarray(out["t"]))
+    truth = DF.assign(city=DF.city.fillna("\0")).groupby("city").uid.nunique()
+    for city, want in truth.items():
+        cid = 0 if city == "\0" else TS.dictionaries["city"].id_of(city)
+        # distinct counts < k=1024, so exact
+        assert est[cid] == want, (city, est[cid], want)
+
+
+def test_theta_merge_matches_union():
+    rng = np.random.default_rng(3)
+    from tpu_olap.kernels.hashing import hash32_int
+    from tpu_olap.kernels.theta import theta_update
+    a_vals = rng.integers(0, 300, 2000).astype(np.int32)
+    b_vals = rng.integers(200, 600, 2000).astype(np.int32)
+    key = np.zeros(2000, np.int32)
+    valid = np.ones(2000, bool)
+    k = 256
+    ta = theta_update(hash32_int(a_vals, np), valid, key, 1, k, np)
+    tb = theta_update(hash32_int(b_vals, np), valid, key, 1, k, np)
+    merged = theta_merge(ta, tb, np)
+    est = theta_estimate(merged)[0]
+    truth = len(set(a_vals.tolist()) | set(b_vals.tolist()))
+    assert abs(est - truth) / truth < 0.15, (est, truth)
+
+
+@pytest.mark.parametrize("xp", [np, jnp], ids=["numpy", "jax"])
+def test_granularity_buckets(xp):
+    pool = ConstPool()
+    t0, t1 = TS.time_boundary
+    plan = compile_granularity(PeriodGranularity("P1M"), t0, t1, pool)
+    assert plan.n_buckets == 12
+    env, valid = flat_env(TS, xp)
+    consts = pool.consts if xp is np else {k: jnp.asarray(v)
+                                           for k, v in pool.consts.items()}
+    ids = np.asarray(plan.ids(env["cols"][TIME_COLUMN], consts))
+    n = TS.segments[0].meta.n_valid
+    want = pd.to_datetime(DF.ts.to_numpy(), unit="ms").month - 1
+    assert (ids[:n] == want.to_numpy()).all()
+
+
+@pytest.mark.parametrize("xp", [np, jnp], ids=["numpy", "jax"])
+def test_time_format_extraction(xp):
+    pool = ConstPool()
+    t0, t1 = TS.time_boundary
+    plan, remap_name, values = compile_time_format("YYYY", "UTC", t0, t1, pool)
+    assert values == ["1993"]
+    plan2, remap2, values2 = compile_time_format("%m", "UTC", t0, t1, pool)
+    assert len(values2) == 12
+    env, _ = flat_env(TS, xp)
+    consts = pool.consts if xp is np else {k: jnp.asarray(v)
+                                           for k, v in pool.consts.items()}
+    fine = np.asarray(plan2.ids(env["cols"][TIME_COLUMN], consts))
+    group = np.asarray(consts[remap2])[fine]
+    n = TS.segments[0].meta.n_valid
+    months = pd.to_datetime(DF.ts.to_numpy(), unit="ms").month
+    want = [values2.index(f"{m:02d}") for m in months]
+    assert (group[:n] == np.asarray(want)).all()
+
+
+@pytest.mark.parametrize("xp", [np, jnp], ids=["numpy", "jax"])
+def test_top_k(xp):
+    metric = np.array([5.0, 1.0, 9.0, 7.0, 3.0])
+    present = np.array([True, True, True, False, True])
+    m = metric if xp is np else jnp.asarray(metric)
+    p = present if xp is np else jnp.asarray(present)
+    idx, valid = top_k_groups(m, p, 3, False, xp)
+    assert np.asarray(idx).tolist() == [2, 0, 4]
+    idx, valid = top_k_groups(m, p, 3, True, xp)
+    assert np.asarray(idx).tolist() == [1, 4, 0]
+    idx, valid = top_k_groups(m, p, 5, False, xp)
+    assert np.asarray(valid).sum() == 4  # absent group never 'valid'
+
+
+def test_jitted_group_reduce_compiles_once():
+    pool = ConstPool()
+    plans = compile_aggregations((SumAggregation("s", "qty", "long"),), TS,
+                                 pool)
+    env, valid = flat_env(TS, jnp)
+    consts = {k: jnp.asarray(v) for k, v in pool.consts.items()}
+
+    calls = {"n": 0}
+
+    def f(env, valid, consts):
+        calls["n"] += 1
+        key, total = build_group_key([env["cols"]["city"]],
+                                     [TS.dictionaries["city"].size + 1], jnp)
+        return group_reduce(key, valid, env, plans, total, consts)
+
+    jf = jax.jit(f)
+    out1 = jf(env, valid, consts)
+    # second call with different consts: no retrace
+    consts2 = dict(consts)
+    out2 = jf(env, valid, consts2)
+    assert calls["n"] == 1
+    assert np.allclose(np.asarray(out1["s"]), np.asarray(out2["s"]))

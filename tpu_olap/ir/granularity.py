@@ -56,8 +56,11 @@ class PeriodGranularity(Granularity):
     origin: int | None = None  # epoch millis; None = natural calendar origin
 
     def is_uniform(self) -> bool:
-        """Fixed-duration bucketing valid (no calendar months/years, UTC)."""
-        return timeutil.period_is_uniform(self.period) and self.time_zone == "UTC"
+        """Fixed-duration bucketing valid: no calendar months/years, and
+        day/week only in UTC (sub-day is DST-safe in any tz)."""
+        return timeutil.period_is_uniform(self.period) and (
+            self.time_zone == "UTC"
+            or timeutil.period_is_subday(self.period))
 
     def to_json(self):
         d = {"type": "period", "period": self.period, "timeZone": self.time_zone}

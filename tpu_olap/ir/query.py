@@ -175,7 +175,10 @@ class TopNQuerySpec(QuerySpec):
             if mtype == "inverted":
                 inverted = True
                 inner = metric.get("metric")
-                metric = inner.get("metric") if isinstance(inner, dict) else inner
+                if isinstance(inner, dict):
+                    metric = inner.get("metric", inner.get("fieldName", ""))
+                else:
+                    metric = inner
             elif mtype == "numeric":
                 metric = metric.get("metric", metric.get("fieldName", ""))
             else:

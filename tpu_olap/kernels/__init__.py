@@ -1,0 +1,25 @@
+"""Device kernels — the in-tree replacement for Druid's segment scan/agg
+engine (SURVEY.md §3.7: "the actual scan+aggregate hot loop").
+
+Design (TPU-first, SURVEY.md §8.2 step 3):
+- Filters lower to vectorized mask math; string predicates become boolean
+  lookup tables over the global dictionary evaluated host-side, so
+  selector/in/regex/like are all one gather on device (filtereval).
+- GROUP BY lowers to a mixed-radix dense group key + XLA segmented reduce
+  (groupby) — static group-table size, no hashing on device.
+- Time bucketing is integer math for uniform periods and a searchsorted
+  over host-computed calendar boundaries otherwise (timebucket).
+- Approximate COUNT DISTINCT: HyperLogLog registers via scatter-max (hll)
+  and theta/KMV sketches via sort-based per-group k-minimums (theta); both
+  merge with elementwise max / re-merge across chips.
+- Query literals are passed as device constants (ConstPool) so the compile
+  cache hits across literal changes (SURVEY.md §8.4 #3).
+"""
+
+from tpu_olap.kernels.filtereval import ConstPool, compile_filter  # noqa: F401
+from tpu_olap.kernels.exprs import eval_expr  # noqa: F401
+from tpu_olap.kernels.timebucket import BucketPlan, compile_granularity  # noqa: F401
+from tpu_olap.kernels.groupby import AggPlan, compile_aggregations, group_reduce  # noqa: F401
+from tpu_olap.kernels.hll import (LOG2M, NUM_REGISTERS, hll_estimate,  # noqa: F401
+                                  hll_update)
+from tpu_olap.kernels.topk import top_k_groups  # noqa: F401

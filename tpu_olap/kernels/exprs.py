@@ -1,0 +1,83 @@
+"""Row-expression evaluation over column arrays (numpy or jax.numpy).
+
+Backs virtual columns and expression filters (tpu_olap.ir.expr). The same
+evaluator serves the device path (jnp) and the CPU fallback (np) so both
+paths share semantics by construction.
+"""
+
+from __future__ import annotations
+
+from tpu_olap.ir.expr import BinOp, Col, Expr, FuncCall, Lit
+
+_ARITH = {
+    "+": lambda a, b: a + b,
+    "-": lambda a, b: a - b,
+    "*": lambda a, b: a * b,
+    "/": lambda a, b: a / b,
+    "%": lambda a, b: a % b,
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "&&": lambda a, b: a & b,
+    "||": lambda a, b: a | b,
+}
+
+
+def eval_expr(expr: Expr, env: dict, xp):
+    """Evaluate an expression AST.
+
+    env maps column name -> array (numeric values; dict codes are NOT
+    valid inputs — the planner resolves string columns before lowering).
+    xp is the array module (numpy or jax.numpy).
+    """
+    if isinstance(expr, Lit):
+        return expr.value
+    if isinstance(expr, Col):
+        if expr.name not in env:
+            raise KeyError(f"unknown column {expr.name!r} in expression")
+        return env[expr.name]
+    if isinstance(expr, BinOp):
+        left = eval_expr(expr.left, env, xp)
+        right = eval_expr(expr.right, env, xp)
+        if expr.op == "/":
+            # SQL-style: integer operands still divide as floats
+            left = _as_float(left, xp)
+        return _ARITH[expr.op](left, right)
+    if isinstance(expr, FuncCall):
+        args = [eval_expr(a, env, xp) for a in expr.args]
+        return _call(expr.name, args, xp)
+    raise TypeError(f"not an expression: {expr!r}")
+
+
+def _as_float(v, xp):
+    from tpu_olap.kernels.hashing import has_x64
+    if hasattr(v, "dtype") and v.dtype.kind in "iu":
+        return v.astype(xp.float64 if has_x64(xp) else xp.float32)
+    return v
+
+
+def _call(name, args, xp):
+    if name == "abs":
+        return xp.abs(args[0])
+    if name == "floor":
+        return xp.floor(args[0])
+    if name == "ceil":
+        return xp.ceil(args[0])
+    if name == "sqrt":
+        return xp.sqrt(args[0])
+    if name == "log":
+        return xp.log(args[0])
+    if name == "exp":
+        return xp.exp(args[0])
+    if name == "pow":
+        return xp.power(args[0], args[1])
+    if name == "if":
+        return xp.where(args[0], args[1], args[2])
+    if name in ("min", "least"):
+        return xp.minimum(args[0], args[1])
+    if name in ("max", "greatest"):
+        return xp.maximum(args[0], args[1])
+    raise ValueError(f"unknown function {name!r} in expression")

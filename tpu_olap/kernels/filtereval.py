@@ -1,0 +1,275 @@
+"""Filter compilation: FilterSpec -> device mask function + constant pool.
+
+The analog of Druid's filter evaluation over bitmap indexes (SURVEY.md
+§3.7), redesigned for TPU: no bitmaps — predicates become vectorized mask
+math over dictionary codes / numeric values. Literals go into a ConstPool
+and are passed as device arrays, so the jitted program is reusable across
+queries that differ only in literal values (compile-cache, §8.4 #3).
+
+Boolean semantics (not SQL 3VL): any comparison with a NULL operand is
+False; NOT inverts the boolean result. The pandas fallback implements the
+same rule so the parity harness compares like with like.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_olap.ir import filters as F
+from tpu_olap.ir.dimensions import (LookupExtractionFn, RegexExtractionFn,
+                                    SubstringExtractionFn,
+                                    TimeFormatExtractionFn)
+from tpu_olap.kernels.exprs import eval_expr
+from tpu_olap.segments.segment import ColumnType, TIME_COLUMN
+
+
+class ConstPool:
+    """Named host constants shipped to the device as a dict pytree."""
+
+    def __init__(self):
+        self.consts: dict[str, np.ndarray] = {}
+        self._n = 0
+
+    def add(self, value, dtype=None) -> str:
+        name = f"c{self._n}"
+        self._n += 1
+        self.consts[name] = np.asarray(value, dtype=dtype)
+        return name
+
+
+class UnsupportedFilter(Exception):
+    """Raised when a filter can't lower to the device path; the planner
+    treats this as 'not rewritable' and falls back (SURVEY.md §2 prop 2)."""
+
+
+def compile_filter(spec, table, pool: ConstPool, virtual_exprs=None):
+    """Compile a FilterSpec to fn(env, consts) -> bool mask.
+
+    env: {"cols": {name: array}, "nulls": {name: bool array}}, where STRING
+    columns hold dictionary codes and numeric columns hold values.
+    virtual_exprs: name -> Expr for virtual columns referenced by filters.
+    """
+    virtual_exprs = virtual_exprs or {}
+
+    def col_type(col):
+        if col in virtual_exprs:
+            return ColumnType.DOUBLE
+        if col not in table.schema:
+            raise UnsupportedFilter(f"unknown column {col!r}")
+        return table.schema[col]
+
+    def numeric_env(env):
+        xp = jnp if _is_jax(env) else np
+        out = dict(env["cols"])
+        for name, ex in virtual_exprs.items():
+            out[name] = eval_expr(ex, out, xp)
+        return out
+
+    def lower(s):
+        if isinstance(s, F.SelectorFilter):
+            return _selector(s, col_type(s.dimension))
+        if isinstance(s, F.BoundFilter):
+            return _bound(s, col_type(s.dimension))
+        if isinstance(s, F.InFilter):
+            return _in(s, col_type(s.dimension))
+        if isinstance(s, F.RegexFilter):
+            return _table_filter(s.dimension, col_type(s.dimension),
+                                 lambda d: d.regex_table(s.pattern))
+        if isinstance(s, F.LikeFilter):
+            return _table_filter(s.dimension, col_type(s.dimension),
+                                 lambda d: d.like_table(s.pattern))
+        if isinstance(s, F.AndFilter):
+            fns = [lower(f) for f in s.fields]
+            return lambda env, c: _fold(fns, env, c, True)
+        if isinstance(s, F.OrFilter):
+            fns = [lower(f) for f in s.fields]
+            return lambda env, c: _fold(fns, env, c, False)
+        if isinstance(s, F.NotFilter):
+            fn = lower(s.field)
+            return lambda env, c: ~fn(env, c)
+        if isinstance(s, F.ExpressionFilter):
+            expr = s.expression
+            for col in expr.columns():
+                if col_type(col) is ColumnType.STRING:
+                    raise UnsupportedFilter(
+                        f"expression filter over string column {col!r}")
+            return lambda env, c: eval_expr(
+                expr, numeric_env(env), jnp if _is_jax(env) else np) != 0
+        raise UnsupportedFilter(f"cannot lower filter {type(s).__name__}")
+
+    # ---- leaf lowerers ---------------------------------------------------
+
+    def _selector(s, typ):
+        col = s.dimension
+        if s.extraction_fn is not None:
+            if typ is not ColumnType.STRING:
+                raise UnsupportedFilter(
+                    "extractionFn filter on non-string column "
+                    f"{col!r} (use intervals/granularity for __time)")
+            d = table.dictionaries[col]
+            ex = _extraction_callable(s.extraction_fn)
+            tbl = d.predicate_table(lambda v: ex(v) == s.value)
+            cname = pool.add(tbl)
+            return lambda env, c: c[cname][env["cols"][col]]
+        if typ is ColumnType.STRING:
+            d = table.dictionaries[col]
+            cid = pool.add(d.id_of(s.value), np.int32)
+            return lambda env, c: env["cols"][col] == c[cid]
+        # numeric
+        if s.value is None:
+            return lambda env, c: _null_mask(env, col)
+        cval = pool.add(float(s.value) if typ is ColumnType.DOUBLE
+                        else int(s.value),
+                        np.float64 if typ is ColumnType.DOUBLE else np.int64)
+        return lambda env, c: ((env["cols"][col] == c[cval])
+                               & ~_null_mask(env, col))
+
+    def _bound(s, typ):
+        col = s.dimension
+        if s.ordering == "numeric" or typ is not ColumnType.STRING \
+                or col == TIME_COLUMN:
+            if typ is ColumnType.STRING:
+                # numeric ordering over a string dim: parse dict host-side
+                d = table.dictionaries[col]
+                tbl = d.predicate_table(
+                    lambda v: _numeric_in_bound(v, s))
+                cname = pool.add(tbl)
+                return lambda env, c: c[cname][env["cols"][col]]
+            dtype = np.float64 if typ is ColumnType.DOUBLE else np.int64
+            parts = []
+            if s.lower is not None:
+                clo = pool.add(dtype(s.lower))
+                if s.lower_strict:
+                    parts.append(lambda env, c: env["cols"][col] > c[clo])
+                else:
+                    parts.append(lambda env, c: env["cols"][col] >= c[clo])
+            if s.upper is not None:
+                chi = pool.add(dtype(s.upper))
+                if s.upper_strict:
+                    parts.append(lambda env, c: env["cols"][col] < c[chi])
+                else:
+                    parts.append(lambda env, c: env["cols"][col] <= c[chi])
+            return lambda env, c: _fold_direct(parts, env, c) \
+                & ~_null_mask(env, col)
+        # lexicographic bound over dictionary codes
+        d = table.dictionaries[col]
+        lo, hi = d.bound_code_range(s.lower, s.upper, s.lower_strict,
+                                    s.upper_strict)
+        clo = pool.add(lo, np.int32)
+        chi = pool.add(hi, np.int32)
+        return lambda env, c: ((env["cols"][col] >= c[clo])
+                               & (env["cols"][col] <= c[chi]))
+
+    def _in(s, typ):
+        col = s.dimension
+        if typ is ColumnType.STRING:
+            d = table.dictionaries[col]
+            cname = pool.add(d.in_table(s.values))
+            return lambda env, c: c[cname][env["cols"][col]]
+        dtype = np.float64 if typ is ColumnType.DOUBLE else np.int64
+        vals = pool.add(np.asarray(
+            [v for v in s.values if v is not None], dtype=dtype))
+        has_null = any(v is None for v in s.values)
+
+        def fn(env, c):
+            x = env["cols"][col]
+            m = (x[..., None] == c[vals]).any(axis=-1) & ~_null_mask(env, col)
+            if has_null:
+                m = m | _null_mask(env, col)
+            return m
+        return fn
+
+    def _table_filter(col, typ, make_table):
+        if typ is not ColumnType.STRING:
+            raise UnsupportedFilter(
+                f"regex/like over non-string column {col!r}")
+        d = table.dictionaries[col]
+        cname = pool.add(make_table(d))
+        return lambda env, c: c[cname][env["cols"][col]]
+
+    return lower(spec)
+
+
+# ---------------------------------------------------------------------------
+
+
+def _null_mask(env, col):
+    m = env["nulls"].get(col)
+    if m is None:
+        x = env["cols"][col]
+        xp = np if isinstance(x, np.ndarray) else jnp
+        return xp.zeros(x.shape, bool)
+    return m
+
+
+def _fold(fns, env, c, is_and):
+    out = None
+    for fn in fns:
+        m = fn(env, c)
+        out = m if out is None else ((out & m) if is_and else (out | m))
+    if out is None:
+        raise UnsupportedFilter("empty and/or filter")
+    return out
+
+
+def _fold_direct(parts, env, c):
+    out = None
+    for fn in parts:
+        m = fn(env, c)
+        out = m if out is None else (out & m)
+    if out is None:
+        raise UnsupportedFilter("bound filter with no bounds")
+    return out
+
+
+def _numeric_in_bound(v: str, s) -> bool:
+    try:
+        x = float(v)
+    except (TypeError, ValueError):
+        return False
+    if s.lower is not None:
+        lo = float(s.lower)
+        if x < lo or (s.lower_strict and x == lo):
+            return False
+    if s.upper is not None:
+        hi = float(s.upper)
+        if x > hi or (s.upper_strict and x == hi):
+            return False
+    return True
+
+
+def _extraction_callable(ex):
+    """Host-side string->string extraction for predicate-table building."""
+    if isinstance(ex, SubstringExtractionFn):
+        def f(v):
+            end = None if ex.length is None else ex.index + ex.length
+            return v[ex.index:end]
+        return f
+    if isinstance(ex, RegexExtractionFn):
+        import re
+        rx = re.compile(ex.expr)
+
+        def f(v):
+            m = rx.search(v)
+            if not m:
+                return ex.replace_missing_value
+            return m.group(1) if m.groups() else m.group(0)
+        return f
+    if isinstance(ex, LookupExtractionFn):
+        table = dict(ex.lookup)
+
+        def f(v):
+            if v in table:
+                return table[v]
+            return v if ex.retain_missing_value else ex.replace_missing_value
+        return f
+    if isinstance(ex, TimeFormatExtractionFn):
+        raise UnsupportedFilter(
+            "timeFormat extraction in filters: use intervals instead")
+    raise UnsupportedFilter(f"unsupported extractionFn {type(ex).__name__}")
+
+
+def _is_jax(env):
+    x = next(iter(env["cols"].values()))
+    return not isinstance(x, np.ndarray)

@@ -1,0 +1,273 @@
+"""Dense group-by: mixed-radix key + XLA segmented reduces.
+
+The TPU-first replacement for Druid's per-segment hash aggregation + broker
+merge (SURVEY.md §3.5 P2/P3): group keys are dense ids (dictionary codes ×
+time buckets), the group table is a static-shape [K] (or [K, m]) array, and
+partial tables from different segments/chips merge with add/min/max — i.e.
+an allreduce, never a hash exchange, as long as K fits the dense budget
+(SURVEY.md §8.4 #1; the planner's cost model guards the budget).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_olap.ir import aggregations as A
+from tpu_olap.kernels import hll as hll_mod
+from tpu_olap.kernels import theta as theta_mod
+from tpu_olap.kernels.exprs import eval_expr
+from tpu_olap.kernels.filtereval import UnsupportedFilter, compile_filter
+from tpu_olap.segments.segment import ColumnType
+
+
+class UnsupportedAggregation(Exception):
+    pass
+
+
+@dataclass
+class AggPlan:
+    name: str
+    kind: str            # sum | min | max | count | hll | theta
+    fields: tuple        # input column/virtual names ((), for count)
+    acc_dtype: object    # accumulator dtype (sum/min/max/count)
+    filter_fn: object = None   # compiled FilterSpec for filtered aggs
+    theta_k: int = 0
+    is_string_input: tuple = ()  # per-field: True if dict codes
+
+
+def compile_aggregations(aggs, table, pool, virtual_exprs=None,
+                         long_dtype=np.int64, double_dtype=np.float64,
+                         theta_k_cap=1 << 14):
+    """AggregationSpec tuple -> list[AggPlan]. Raises Unsupported* for specs
+    the device path can't run (planner then falls back)."""
+    virtual_exprs = virtual_exprs or {}
+    plans = []
+
+    def field_type(f):
+        if f in virtual_exprs:
+            return ColumnType.DOUBLE
+        if f not in table.schema:
+            raise UnsupportedAggregation(f"unknown field {f!r}")
+        return table.schema[f]
+
+    def acc_dtype_for(spec):
+        return long_dtype if spec.value_type == "long" else double_dtype
+
+    def lower(spec, filter_fn=None):
+        if isinstance(spec, A.FilteredAggregation):
+            if filter_fn is not None:
+                raise UnsupportedAggregation("nested filtered aggregator")
+            try:
+                ffn = compile_filter(spec.filter, table, pool, virtual_exprs)
+            except UnsupportedFilter as e:
+                raise UnsupportedAggregation(str(e)) from e
+            return lower(spec.aggregator, ffn)
+        if isinstance(spec, A.CountAggregation):
+            return AggPlan(spec.name, "count", (), long_dtype, filter_fn)
+        if isinstance(spec, (A.SumAggregation, A.MinAggregation,
+                             A.MaxAggregation)):
+            if field_type(spec.field_name) is ColumnType.STRING:
+                raise UnsupportedAggregation(
+                    f"numeric agg over string column {spec.field_name!r}")
+            kind = {"SumAggregation": "sum", "MinAggregation": "min",
+                    "MaxAggregation": "max"}[type(spec).__name__]
+            return AggPlan(spec.name, kind, (spec.field_name,),
+                           acc_dtype_for(spec), filter_fn)
+        if isinstance(spec, A.CardinalityAggregation):
+            fields = tuple(spec.fields)
+            return AggPlan(spec.name, "hll", fields, np.int32, filter_fn,
+                           is_string_input=tuple(
+                               field_type(f) is ColumnType.STRING
+                               for f in fields))
+        if isinstance(spec, A.HyperUniqueAggregation):
+            return AggPlan(spec.name, "hll", (spec.field_name,), np.int32,
+                           filter_fn,
+                           is_string_input=(field_type(spec.field_name)
+                                            is ColumnType.STRING,))
+        if isinstance(spec, A.ThetaSketchAggregation):
+            k = min(int(spec.size), theta_k_cap)
+            return AggPlan(spec.name, "theta", (spec.field_name,),
+                           np.float64, filter_fn, theta_k=k,
+                           is_string_input=(field_type(spec.field_name)
+                                            is ColumnType.STRING,))
+        raise UnsupportedAggregation(
+            f"cannot lower aggregation {type(spec).__name__}")
+
+    for a in aggs:
+        plans.append(lower(a))
+    return plans
+
+
+def build_group_key(ids, sizes, xp):
+    """Mixed-radix combine of dense id arrays into one int32 key.
+
+    ids: list of arrays in [0, size_i); sizes: list of ints. The product
+    must fit in int32 — callers enforce the dense-K budget.
+    """
+    total = 1
+    for s in sizes:
+        total *= int(s)
+    if total > (1 << 31) - 1:
+        raise UnsupportedAggregation(
+            f"dense group space {total} overflows int32")
+    key = None
+    for i, s in zip(ids, sizes):
+        i = i.astype(xp.int32)
+        key = i if key is None else key * xp.int32(s) + i
+    if key is None:
+        key = xp.zeros((), xp.int32)
+    return key, total
+
+
+def group_reduce(key, mask, env, plans, num_groups, consts):
+    """One segment batch -> per-group partial aggregates.
+
+    key: [N] int32 dense group ids; mask: [N] bool (validity ∧ filter);
+    env: {"cols", "nulls"} with numeric/virtual columns materialized.
+    Returns dict: "_rows" -> [K] row counts, then one entry per plan —
+    [K] arrays for sum/min/max/count, [K, m] registers for hll,
+    ([K, k] hashes, [K] counts) for theta. All outputs are mergeable
+    across segments/chips (add for sums/counts, min/max elementwise,
+    hll max, theta re-merge).
+    """
+    xp = jnp if not isinstance(mask, np.ndarray) else np
+    out = {}
+    key = xp.where(mask, key, 0)  # masked rows: contribute zeros to group 0
+    out["_rows"] = _seg_sum(mask.astype(np.int32), key, num_groups, xp)
+
+    for p in plans:
+        m = mask if p.filter_fn is None else (mask & p.filter_fn(env, consts))
+        if p.filter_fn is not None:
+            m_key = xp.where(m, key, 0)
+        else:
+            m_key = key
+        if p.kind == "count":
+            out[p.name] = _seg_sum(m.astype(p.acc_dtype), m_key, num_groups,
+                                   xp)
+            continue
+        if p.kind in ("sum", "min", "max"):
+            x = _field_value(env, p.fields[0], xp)
+            nulls = env["nulls"].get(p.fields[0])
+            mm = m & ~nulls if nulls is not None else m
+            if p.kind == "sum":
+                v = xp.where(mm, x, 0).astype(p.acc_dtype)
+                out[p.name] = _seg_sum(v, xp.where(mm, key, 0), num_groups, xp)
+            else:
+                ident = _ident(p.acc_dtype, p.kind)
+                v = xp.where(mm, x.astype(p.acc_dtype), ident)
+                out[p.name] = _seg_minmax(v, xp.where(mm, key, 0), num_groups,
+                                          p.kind, xp)
+            # per-plan non-null counts for null-correct finalize
+            out[f"_nn_{p.name}"] = _seg_sum(mm.astype(np.int32),
+                                            xp.where(mm, key, 0),
+                                            num_groups, xp)
+            continue
+        if p.kind == "hll":
+            h, valid = _hash_fields(env, p, m, xp)
+            out[p.name] = hll_mod.hll_update(h, valid,
+                                             xp.where(valid, key, 0),
+                                             num_groups, xp)
+            continue
+        if p.kind == "theta":
+            h, valid = _hash_fields(env, p, m, xp)
+            out[p.name] = theta_mod.theta_update(h, valid, key, num_groups,
+                                                 p.theta_k, xp)
+            continue
+        raise UnsupportedAggregation(p.kind)
+    return out
+
+
+def merge_partials(a: dict, b: dict, plans) -> dict:
+    """Merge two partial-aggregate dicts (tree-reduce across segments; the
+    same op runs as an ICI collective across chips)."""
+    xp = jnp if not isinstance(a["_rows"], np.ndarray) else np
+    out = {"_rows": a["_rows"] + b["_rows"]}
+    for p in plans:
+        if p.kind in ("count", "sum"):
+            out[p.name] = a[p.name] + b[p.name]
+        elif p.kind == "min":
+            out[p.name] = xp.minimum(a[p.name], b[p.name])
+        elif p.kind == "max":
+            out[p.name] = xp.maximum(a[p.name], b[p.name])
+        elif p.kind == "hll":
+            out[p.name] = xp.maximum(a[p.name], b[p.name])
+        elif p.kind == "theta":
+            out[p.name] = theta_mod.theta_merge(a[p.name], b[p.name], xp)
+        if f"_nn_{p.name}" in a:
+            out[f"_nn_{p.name}"] = a[f"_nn_{p.name}"] + b[f"_nn_{p.name}"]
+    return out
+
+
+# ---------------------------------------------------------------------------
+
+
+def _field_value(env, field, xp):
+    if field in env["cols"]:
+        return env["cols"][field]
+    raise UnsupportedAggregation(f"field {field!r} not materialized")
+
+
+def _seg_sum(v, key, k, xp):
+    if xp is np:
+        out = np.zeros((k,) + v.shape[1:], v.dtype)
+        np.add.at(out, key, v)
+        return out
+    return jax.ops.segment_sum(v, key, num_segments=k)
+
+
+def _seg_minmax(v, key, k, kind, xp):
+    if xp is np:
+        ident = _ident(v.dtype, kind)
+        out = np.full((k,), ident, v.dtype)
+        (np.minimum if kind == "min" else np.maximum).at(out, key, v)
+        return out
+    f = jax.ops.segment_min if kind == "min" else jax.ops.segment_max
+    return f(v, key, num_segments=k)
+
+
+def _ident(dtype, kind):
+    dt = np.dtype(dtype)
+    if dt.kind == "f":
+        return dt.type(np.inf if kind == "min" else -np.inf)
+    info = np.iinfo(dt)
+    return dt.type(info.max if kind == "min" else info.min)
+
+
+def _hash_fields(env, p: AggPlan, mask, xp):
+    """Rows -> 32-bit hashes of the (possibly multi-)field value; valid
+    excludes SQL-null inputs (nulls don't count toward COUNT DISTINCT)."""
+    from tpu_olap.kernels.hashing import hash32_int, hash_combine
+
+    h = None
+    valid = mask
+    for f, is_code in zip(p.fields, p.is_string_input):
+        x = env["cols"][f]
+        if is_code:
+            valid = valid & (x > 0)  # code 0 = null
+            hx = hash32_int(x.astype(xp.int32), xp)
+        else:
+            nulls = env["nulls"].get(f)
+            if nulls is not None:
+                valid = valid & ~nulls
+            if x.dtype.kind == "f":
+                xi = _float_bits(x, xp)
+            elif x.dtype.itemsize == 8:
+                # fold all 64 bits before narrowing so values differing
+                # only in high bits don't collide structurally
+                xi = (x ^ (x >> 32)).astype(xp.int32)
+            else:
+                xi = x.astype(xp.int32)
+            hx = hash32_int(xi, xp)
+        h = hx if h is None else hash_combine(h, hx, xp)
+    return h, valid
+
+
+def _float_bits(x, xp):
+    x32 = x.astype(xp.float32)
+    if xp is np:
+        return x32.view(np.int32)
+    return jax.lax.bitcast_convert_type(x32, jnp.int32)

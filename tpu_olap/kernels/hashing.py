@@ -1,0 +1,47 @@
+"""32-bit integer mixing for sketch hashing (HLL / theta).
+
+All ops stay in int32 so the TPU path never needs 64-bit lanes. The mix is
+the standard Murmur3 finalizer, good avalanche for dense dictionary codes.
+Both numpy and jax.numpy accept the same code (with explicit uint casts).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _u32(x, xp):
+    return x.astype(xp.uint32)
+
+
+def hash32_int(x, xp):
+    """Murmur3 fmix32 over an int32 array -> int32 (well-mixed)."""
+    h = _u32(x, xp)
+    h = h ^ (h >> 16)
+    h = h * xp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * xp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h.astype(xp.int32)
+
+
+def hash_combine(a, b, xp):
+    """Order-dependent combine (boost::hash_combine flavored)."""
+    ua = _u32(a, xp)
+    ub = _u32(b, xp)
+    ua = ua ^ (ub + xp.uint32(0x9E3779B9) + (ua << 6) + (ua >> 2))
+    return hash32_int(ua.astype(xp.int32), xp)
+
+
+def to_unit_float(h, xp):
+    """int32 hash -> float in [0, 1) (treating bits as uint32)."""
+    u = _u32(h, xp).astype(xp.float64 if has_x64(xp) else xp.float32)
+    return u / np.float64(2**32)
+
+
+def has_x64(xp) -> bool:
+    """Widest float available for this array module (shared helper)."""
+    if xp is np:
+        return True
+    from jax import config
+    return bool(config.jax_enable_x64)

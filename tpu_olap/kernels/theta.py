@@ -1,0 +1,94 @@
+"""Theta (KMV) sketch count-distinct per group, sort-based, static shapes.
+
+The datasketches-extension analog (SURVEY.md §3.3 Theta-sketch aggregator),
+re-designed for XLA: per group keep the k smallest *distinct* 32-bit hash
+values. Update is a lexsort + within-group rank + scatter (no dynamic
+shapes); merge concatenates two [K, k] tables and re-selects k minimums —
+both jittable, so merge also rides the ICI collective path.
+
+State: float64 table [K, k] of hash values mapped to [0,1) (1.0 = empty
+slot), plus implicit count = #slots < 1.0. Estimate: if the table is not
+full, the count is exact; else (k-1)/theta with theta = k-th smallest.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_olap.kernels.hashing import to_unit_float
+
+EMPTY = 1.0  # sentinel: empty slot (hashes are in [0, 1))
+
+
+def theta_update(h, valid, key, num_groups, k, xp):
+    """h: [N] int32 hashes; -> [K, k] sorted unit-hash table."""
+    u = to_unit_float(h, xp)
+    u = xp.where(valid, u, EMPTY)
+    g = xp.where(valid, key.astype(xp.int32), num_groups)  # invalid -> end
+    if xp is np:
+        order = np.lexsort((u, g))
+    else:
+        order = jnp.lexsort((u, g))
+    gs, us = g[order], u[order]
+    first = xp.ones(gs.shape, bool)
+    if gs.shape[0] > 1:
+        dup = (gs[1:] == gs[:-1]) & (us[1:] == us[:-1])
+        first = xp.concatenate([first[:1], ~dup])
+    kept = first & (gs < num_groups) & (us < EMPTY)
+    # rank of each kept row within its group
+    idx = xp.arange(gs.shape[0])
+    prefix = xp.cumsum(kept.astype(xp.int32)) - kept.astype(xp.int32)
+    start = _seg_min(xp.where(kept, prefix, np.int32(2**31 - 1)), gs,
+                     num_groups + 1, xp)
+    rank = prefix - start[gs]
+    ok = kept & (rank < k)
+    flat = xp.where(ok, gs * np.int32(k) + rank.astype(xp.int32), 0)
+    vals = xp.where(ok, us, EMPTY)
+    table = _scatter_min(vals, flat, num_groups * k, xp)
+    return table.reshape(num_groups, k)
+
+
+def theta_merge(a, b, xp):
+    """[K, k] + [K, k] -> [K, k]: keep k smallest distinct of the union."""
+    k = a.shape[-1]
+    both = xp.concatenate([a, b], axis=-1)
+    both = xp.sort(both, axis=-1)
+    # dedupe equal neighbors (same hash from both sides)
+    dup = xp.concatenate(
+        [xp.zeros(both.shape[:-1] + (1,), bool), both[..., 1:] == both[..., :-1]],
+        axis=-1)
+    both = xp.where(dup, EMPTY, both)
+    both = xp.sort(both, axis=-1)
+    return both[..., :k]
+
+
+def theta_estimate(table: np.ndarray) -> np.ndarray:
+    """[K, k] sorted unit-hash table -> [K] float estimates (host)."""
+    t = np.asarray(table, np.float64)
+    k = t.shape[-1]
+    count = (t < EMPTY).sum(axis=-1)
+    full = count >= k
+    theta = t[..., -1]
+    with np.errstate(divide="ignore", invalid="ignore"):
+        est_full = (k - 1) / np.maximum(theta, 1e-300)
+    return np.where(full, est_full, count.astype(np.float64))
+
+
+def _seg_min(v, key, n, xp):
+    if xp is np:
+        out = np.full(n, 2**31 - 1, np.int32)
+        np.minimum.at(out, key, v.astype(np.int32))
+        return out
+    import jax
+    return jax.ops.segment_min(v.astype(jnp.int32), key, num_segments=n)
+
+
+def _scatter_min(v, flat, n, xp):
+    if xp is np:
+        out = np.full(n, EMPTY, np.float64)
+        np.minimum.at(out, flat, v)
+        return out
+    import jax
+    return jnp.minimum(
+        jax.ops.segment_min(v, flat, num_segments=n), EMPTY)

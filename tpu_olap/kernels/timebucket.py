@@ -1,0 +1,168 @@
+"""Granularity compilation: time column -> dense bucket ids.
+
+Uniform periods (hour/day/... in UTC, duration) are integer floor-divide on
+device; calendar periods (month/quarter/year, or any non-UTC tz) use a
+host-computed boundary array + vectorized searchsorted (SURVEY.md §8.2
+step 3 "time bucketing"). Either way the result is a dense id in
+[0, n_buckets) suitable for the mixed-radix group key.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_olap.ir.granularity import (AllGranularity, DurationGranularity,
+                                     Granularity, NoneGranularity,
+                                     PeriodGranularity)
+from tpu_olap.utils import timeutil
+
+
+class UnsupportedGranularity(Exception):
+    pass
+
+
+@dataclass
+class BucketPlan:
+    """Host-side plan: how many buckets over [t_min, t_max] and their start
+    timestamps; `ids(time, consts)` computes in-range dense ids on device
+    (out-of-range rows clip into 0 / n-1 — callers must mask them)."""
+
+    n_buckets: int
+    starts: np.ndarray  # [n_buckets] epoch millis (bucket starts)
+    kind: str           # "all" | "uniform" | "boundaries"
+    origin_name: str | None = None
+    step_name: str | None = None
+    boundaries_name: str | None = None
+
+    def ids(self, time, consts):
+        xp = jnp if not isinstance(time, np.ndarray) else np
+        if self.kind == "all":
+            return xp.zeros(time.shape, xp.int32)
+        if self.kind == "uniform":
+            origin = consts[self.origin_name]
+            step = consts[self.step_name]
+            i = (time - origin) // step
+            return xp.clip(i, 0, self.n_buckets - 1).astype(xp.int32)
+        bs = consts[self.boundaries_name]
+        i = xp.searchsorted(bs, time, side="right") - 1
+        return xp.clip(i, 0, self.n_buckets - 1).astype(xp.int32)
+
+
+def compile_granularity(gran: Granularity, t_min: int, t_max: int,
+                        pool) -> BucketPlan:
+    """t_min/t_max: inclusive millis range actually queried (intervals ∩
+    table time boundary). pool: ConstPool for device constants."""
+    if isinstance(gran, AllGranularity):
+        return BucketPlan(1, np.array([t_min], np.int64), "all")
+    if isinstance(gran, NoneGranularity):
+        raise UnsupportedGranularity(
+            "granularity 'none' (per-millisecond buckets) is not supported "
+            "on the dense device path")
+    if isinstance(gran, DurationGranularity):
+        step = int(gran.duration)
+        if step <= 0:
+            raise UnsupportedGranularity("duration must be positive")
+        origin = gran.origin + ((t_min - gran.origin) // step) * step
+        n = int((t_max - origin) // step) + 1
+        starts = origin + step * np.arange(n, dtype=np.int64)
+        return BucketPlan(n, starts, "uniform",
+                          origin_name=pool.add(origin, np.int64),
+                          step_name=pool.add(step, np.int64))
+    if isinstance(gran, PeriodGranularity):
+        if gran.origin is not None:
+            # explicit origin pins alignment: pure epoch stepping, but only
+            # meaningful for fixed-duration periods (sub-day in any tz,
+            # day/week in UTC — elsewhere local midnight drifts off origin)
+            if not gran.is_uniform():
+                raise UnsupportedGranularity(
+                    "custom origin requires a fixed-duration period "
+                    "(calendar periods / day in a DST tz not supported)")
+            step = timeutil.period_millis(gran.period)
+            origin = gran.origin + ((t_min - gran.origin) // step) * step
+            n = int((t_max - origin) // step) + 1
+            starts = origin + step * np.arange(n, dtype=np.int64)
+            return BucketPlan(n, starts, "uniform",
+                              origin_name=pool.add(origin, np.int64),
+                              step_name=pool.add(step, np.int64))
+        if gran.is_uniform():
+            step = timeutil.period_millis(gran.period)
+            # natural alignment: floor t_min to the local period start
+            bs = timeutil.calendar_boundaries(gran.period, gran.time_zone,
+                                              t_min, t_min)
+            origin = bs[0]
+            n = int((t_max - origin) // step) + 1
+            starts = origin + step * np.arange(n, dtype=np.int64)
+            return BucketPlan(n, starts, "uniform",
+                              origin_name=pool.add(origin, np.int64),
+                              step_name=pool.add(step, np.int64))
+        bs = np.asarray(timeutil.calendar_boundaries(
+            gran.period, gran.time_zone, t_min, t_max), np.int64)
+        n = len(bs) - 1
+        return BucketPlan(n, bs[:-1], "boundaries",
+                          boundaries_name=pool.add(bs))
+    raise UnsupportedGranularity(f"unknown granularity {gran!r}")
+
+
+# ---------------------------------------------------------------------------
+# Time-format extraction: bucket remap through host-formatted bucket starts.
+
+_FORMAT_FINEST = (
+    (("%S", "ss", "SS"), "PT1S"),
+    (("%M", "mm"), "PT1M"),
+    (("%H", "HH", "hh"), "PT1H"),
+    (("%d", "dd", "DD", "%j"), "P1D"),
+    (("%m", "MM", "%b", "%B"), "P1M"),
+    (("%Y", "%y", "YYYY", "yyyy", "YY"), "P1Y"),
+)
+
+_SHORTHAND = {
+    "YYYY": "%Y", "yyyy": "%Y", "YY": "%y",
+    "MM": "%m", "dd": "%d", "DD": "%d",
+    "HH": "%H", "hh": "%H", "mm": "%M", "ss": "%S", "SS": "%S",
+}
+
+
+def format_finest_period(fmt: str) -> str:
+    for needles, period in _FORMAT_FINEST:
+        if any(nd in fmt for nd in needles):
+            return period
+    return "P1Y"
+
+
+def strftime_of(fmt: str) -> str:
+    """Translate joda-ish shorthands (YYYY, MM, dd...) to strftime."""
+    if "%" in fmt:
+        return fmt
+    out = fmt
+    for k in sorted(_SHORTHAND, key=len, reverse=True):
+        out = out.replace(k, _SHORTHAND[k])
+    return out
+
+
+def compile_time_format(fmt: str, tz: str, t_min: int, t_max: int, pool):
+    """TimeFormatExtractionFn -> (BucketPlan over the finest needed period,
+    remap const name, group value strings).
+
+    Device work: fine bucket id -> gather remap -> dense group id. The
+    formatted strings (group labels) are computed host-side only for the
+    bucket *starts* — never per row (SURVEY.md §8.2's host/device split).
+    """
+    import datetime as dt
+    from zoneinfo import ZoneInfo
+
+    period = format_finest_period(fmt)
+    plan = compile_granularity(PeriodGranularity(period, tz), t_min, t_max,
+                               pool)
+    sf = strftime_of(fmt)
+    zone = ZoneInfo(tz)
+    labels = [dt.datetime.fromtimestamp(ms / 1000, tz=zone).strftime(sf)
+              for ms in plan.starts]
+    # distinct labels, sorted (Druid sorts extraction outputs lexically)
+    values = sorted(set(labels))
+    index = {v: i for i, v in enumerate(values)}
+    remap = np.asarray([index[x] for x in labels], np.int32)
+    remap_name = pool.add(remap)
+    return plan, remap_name, values

@@ -1,0 +1,35 @@
+"""Top-K group selection (TopN queries).
+
+Unlike Druid's *approximate* per-segment topN + broker re-rank (SURVEY.md
+§8.4 #2), the dense group table makes exact top-K cheap: one lax.top_k
+over the [K] metric array. Druid-approximate behavior is therefore a
+strict-accuracy win, not a compatibility break; the context flag
+`useApproximateTopN` exists for parity testing but maps to the same exact
+kernel.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_olap.kernels.hashing import has_x64
+
+
+def top_k_groups(metric, present, threshold: int, inverted: bool, xp):
+    """metric: [K] values; present: [K] bool (group has rows).
+
+    Returns (indices [threshold], valid [threshold]) — group ids of the
+    top-`threshold` by metric (bottom if inverted), absent groups last.
+    """
+    k = min(int(threshold), metric.shape[-1])
+    v = metric.astype(xp.float64 if has_x64(xp) else xp.float32)
+    v = xp.where(present, -v if inverted else v, -xp.inf)
+    if xp is np:
+        order = np.argsort(-v, kind="stable")[:k]
+        vals = v[order]
+    else:
+        import jax
+        vals, order = jax.lax.top_k(v, k)
+    valid = vals > -xp.inf
+    return order, valid

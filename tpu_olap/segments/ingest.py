@@ -85,9 +85,10 @@ def ingest_arrow(name: str, table, time_column: str | None = None,
             schema[col] = ColumnType.DOUBLE
             v = arr.to_numpy(zero_copy_only=False).astype(np.float64)
             # genuine NaN values (valid Arrow values) fold into the null
-            # mask, matching SQL NULL semantics and keeping kernels NaN-free
+            # mask, matching SQL NULL semantics and keeping kernels NaN-free;
+            # +/-inf are preserved as real values
             null_mask = null_mask | np.isnan(v)
-            raw[col] = np.nan_to_num(v)
+            raw[col] = np.where(null_mask, 0.0, v)
             if null_mask.any():
                 nulls[col] = null_mask
         elif pa.types.is_integer(t) or pa.types.is_boolean(t):

@@ -100,9 +100,17 @@ class TableSegments:
                 entry["cardinality"] = d.cardinality
                 entry["size"] = int(sum(len(v) for v in d.values))
             else:
-                arrs = [s.columns[col][:s.meta.n_valid] for s in self.segments
-                        if s.meta.n_valid]
+                arrs = []
+                for s in self.segments:
+                    if not s.meta.n_valid:
+                        continue
+                    a = s.columns[col][:s.meta.n_valid]
+                    nm = s.null_masks.get(col)
+                    if nm is not None:
+                        a = a[~nm[:s.meta.n_valid]]
+                    arrs.append(a)
                 entry["size"] = int(sum(a.nbytes for a in arrs))
+                arrs = [a for a in arrs if len(a)]
                 if arrs:
                     entry["min"] = _scalar(min(a.min() for a in arrs))
                     entry["max"] = _scalar(max(a.max() for a in arrs))

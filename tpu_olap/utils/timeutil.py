@@ -41,12 +41,21 @@ def parse_period(period: str) -> dict:
 def period_is_uniform(period: str) -> bool:
     """True if the period is a fixed number of millis (no months/years).
 
-    Weeks/days are treated as uniform; DST shifts for day-granularity in a
-    DST-observing timezone are handled by the boundary-array path, which the
-    caller selects when tz is not fixed-offset (see calendar_boundaries).
+    Weeks/days count as uniform in UTC only; in a DST-observing timezone
+    day/week buckets must track local midnight (see period_is_subday and
+    calendar_boundaries' path selection).
     """
     parts = parse_period(period)
     return not (parts.get("years") or parts.get("months"))
+
+
+def period_is_subday(period: str) -> bool:
+    """True for pure hour/minute/second periods — DST-safe under fixed
+    epoch stepping in any timezone (DST only shifts whole-period-multiple
+    offsets for these)."""
+    parts = parse_period(period)
+    return not (parts.get("years") or parts.get("months")
+                or parts.get("weeks") or parts.get("days"))
 
 
 def period_millis(period: str) -> int:
@@ -162,11 +171,12 @@ def calendar_boundaries(period: str, tz: str, t_min_ms: int, t_max_ms: int) -> l
     d = _dt.datetime.fromtimestamp(t_min_ms / 1000.0, tz=zone)
     d = _floor_to_period_start(d, parts)
     out = []
-    if period_is_uniform(period) and tz == "UTC":
-        # Fixed-duration stepping in epoch space. Only valid in UTC: in a
-        # DST-observing tz, day/week buckets must follow local midnight, so
-        # they take the wall-clock _advance path below (which dedupes the
-        # repeated instant at spring-forward).
+    if period_is_uniform(period) and (tz == "UTC" or period_is_subday(period)):
+        # Fixed-duration stepping in epoch space. Valid in UTC always, and
+        # for sub-day periods in any tz (hour buckets stay hour-aligned
+        # across DST, including the repeated fall-back hour). Day/week in a
+        # DST tz must follow local midnight, so they take the wall-clock
+        # _advance path below (which dedupes the spring-forward instant).
         step = period_millis(period)
         ms = int(d.timestamp() * 1000)
         while True:
