@@ -1,0 +1,10 @@
+"""Executor — the analog of the reference's DruidRDD + Druid's query engine
+(SURVEY.md §3.5, §8.2 steps 4/7): lowers a QuerySpec over a registered
+table's segments to a jitted XLA program, caches compiled programs by query
+*template* (literals stripped), keeps columns HBM-resident, and assembles
+Druid-shaped results host-side. Multi-chip execution shards the segment axis
+over a Mesh and merges partials with XLA collectives (sharding.py).
+"""
+
+from tpu_olap.executor.config import EngineConfig  # noqa: F401
+from tpu_olap.executor.runner import QueryRunner, QueryResult  # noqa: F401

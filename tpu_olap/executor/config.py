@@ -1,0 +1,58 @@
+"""Engine configuration — the session-level half of the reference's
+two-layer config (SURVEY.md §6 "Config / flag system": session SQLConf keys
+`spark.sparklinedata.*`; per-table options live in catalog.TableOptions).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class EngineConfig:
+    # dtype policy: int64/float64 accumulators give exact parity (x64 is
+    # emulated on TPU; measured acceptable for reduce-dominated kernels).
+    long_dtype: object = np.int64
+    double_dtype: object = np.float64
+    enable_x64: bool = True
+
+    # dense group-by budget: max total groups (dims × buckets product) the
+    # dense table may hold before the query is declared non-rewritable
+    # (SURVEY.md §8.4 #1). 2^22 groups × 8B ≈ 32 MB per aggregator.
+    dense_group_budget: int = 1 << 22
+
+    # theta sketch nominal-entries cap (k × groups × 8B of HBM)
+    theta_k_cap: int = 1 << 14
+
+    # segments per device dispatch (flattened rows = batch × block_rows)
+    max_segments_per_dispatch: int = 1 << 10
+
+    # execution platform: "device" = default jax backend, "cpu" = numpy path
+    platform: str = "device"
+
+    # emit empty time buckets in timeseries results (Druid default)
+    skip_empty_buckets: bool = False
+
+    # reference's `allowTopN` / topN threshold guard (SURVEY.md §3.2
+    # LimitTransform); used by the planner
+    allow_topn: bool = True
+    topn_max_threshold: int = 100_000
+
+    # reference's allowCountDistinct: push COUNT(DISTINCT) as HLL
+    allow_count_distinct: bool = True
+
+    # session timezone for granularity math (reference: tz.id conf key)
+    time_zone: str = "UTC"
+
+    # cost model knobs (planner.cost)
+    cost_model_enabled: bool = True
+    shard_merge_factor: float = 1.0
+
+    extra: dict = field(default_factory=dict)
+
+    def apply_x64(self):
+        if self.enable_x64:
+            import jax
+            jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,80 @@
+"""Device-resident column cache: stacked [n_segments, block_rows] arrays.
+
+The analog of Druid historicals' memory-mapped segments (SURVEY.md §2 L1):
+columns are uploaded to the device once per table (lazily, per column) and
+reused across queries — the Parquet→HBM streaming half of BASELINE.json:5.
+Interval pruning is applied as a per-segment mask (columns stay resident;
+masked segments cost compute but no transfer — the dense-scan tradeoff).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpu_olap.segments.segment import TableSegments
+
+
+class DeviceDataset:
+    """Lazy per-column stacks for one table on one platform."""
+
+    def __init__(self, table: TableSegments, platform: str = "device"):
+        self.table = table
+        self.platform = platform
+        self._cols: dict[str, object] = {}
+        self._nulls: dict[str, object] = {}
+        self._valid = None
+        n_seg = len(table.segments)
+        self.shape = (n_seg, table.block_rows)
+
+    def _put(self, arr: np.ndarray):
+        if self.platform == "cpu":
+            return arr
+        import jax
+        return jax.device_put(arr)
+
+    def col(self, name: str):
+        if name not in self._cols:
+            stack = np.stack([s.columns[name] for s in self.table.segments])
+            self._cols[name] = self._put(stack)
+        return self._cols[name]
+
+    def null_mask(self, name: str):
+        """None if the column has no nulls anywhere."""
+        if name not in self._nulls:
+            if any(name in s.null_masks for s in self.table.segments):
+                stack = np.stack([
+                    s.null_masks.get(name,
+                                     np.zeros(self.table.block_rows, bool))
+                    for s in self.table.segments])
+                self._nulls[name] = self._put(stack)
+            else:
+                self._nulls[name] = None
+        return self._nulls[name]
+
+    def valid(self):
+        """[S, R] row-validity (padding rows are False)."""
+        if self._valid is None:
+            r = np.arange(self.table.block_rows)
+            stack = np.stack([r < s.meta.n_valid
+                              for s in self.table.segments])
+            self._valid = self._put(stack)
+        return self._valid
+
+    def segment_mask(self, kept_ids) -> np.ndarray:
+        """Host-side [S] bool from pruned segment ids (device input arg)."""
+        m = np.zeros(self.shape[0], bool)
+        m[list(kept_ids)] = True
+        return m
+
+    def env(self, columns, null_cols):
+        """Build the kernel env for the requested columns."""
+        return {
+            "cols": {c: self.col(c) for c in columns},
+            "nulls": {c: m for c in null_cols
+                      if (m := self.null_mask(c)) is not None},
+        }
+
+    def evict(self):
+        self._cols.clear()
+        self._nulls.clear()
+        self._valid = None

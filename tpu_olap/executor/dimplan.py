@@ -1,0 +1,122 @@
+"""Dimension lowering: DimensionSpec -> dense ids + labels.
+
+Every grouped dimension becomes a dense id in [0, size): dictionary codes
+for string dims (0 = null), value-offset for bounded numeric dims, and a
+host-computed remap table for extraction dims (substring/regex/lookup over
+the dictionary; timeFormat over bucket starts). This is what makes the
+group key mixed-radix (kernels.groupby) and group tables mergeable across
+chips without string exchange.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from tpu_olap.ir.dimensions import (DefaultDimensionSpec,
+                                    ExtractionDimensionSpec,
+                                    TimeFormatExtractionFn)
+from tpu_olap.kernels.filtereval import _extraction_callable
+from tpu_olap.kernels.timebucket import compile_time_format
+from tpu_olap.segments.segment import ColumnType, TIME_COLUMN
+
+
+class UnsupportedDimension(Exception):
+    pass
+
+
+@dataclass
+class DimPlan:
+    name: str          # output name
+    size: int          # dense id space size
+    labels: object     # np object array [size] of output values (None=null)
+    source_col: str | None   # column whose array feeds ids() (None = time)
+    kind: str          # "codes" | "numeric" | "remap" | "timeformat"
+    remap_name: str | None = None   # ConstPool name for remap/offset consts
+    offset_name: str | None = None
+    time_plan: object = None        # BucketPlan for timeformat dims
+
+    def ids(self, env, consts, xp):
+        if self.kind == "codes":
+            return env["cols"][self.source_col]
+        if self.kind == "numeric":
+            v = env["cols"][self.source_col]
+            i = (v - consts[self.offset_name]).astype(xp.int32)
+            # out-of-range/null -> slot 0 (null); executor masks via labels
+            i = xp.where((i >= 1) & (i < self.size), i, 0)
+            nm = env["nulls"].get(self.source_col)
+            if nm is not None:
+                i = xp.where(nm, 0, i)
+            return i
+        if self.kind == "remap":
+            codes = env["cols"][self.source_col]
+            return consts[self.remap_name][codes]
+        if self.kind == "timeformat":
+            fine = self.time_plan.ids(env["cols"][TIME_COLUMN], consts)
+            return consts[self.remap_name][fine]
+        raise AssertionError(self.kind)
+
+
+def compile_dimension(spec, table, pool, t_min, t_max,
+                      numeric_dim_budget=1 << 20) -> DimPlan:
+    if isinstance(spec, DefaultDimensionSpec):
+        col = spec.dimension
+        if col not in table.schema:
+            raise UnsupportedDimension(f"unknown dimension {col!r}")
+        typ = table.schema[col]
+        if typ is ColumnType.STRING:
+            d = table.dictionaries[col]
+            labels = np.empty(d.size + 1, object)
+            labels[0] = None
+            labels[1:] = d.values
+            return DimPlan(spec.name, d.size + 1, labels, col, "codes")
+        if typ is ColumnType.LONG:
+            md = table.column_metadata([col])[col]
+            lo, hi = md.get("min"), md.get("max")
+            if lo is None:
+                # empty table: single null slot
+                return DimPlan(spec.name, 1, np.array([None], object), col,
+                               "numeric", offset_name=pool.add(0, np.int64))
+            size = int(hi - lo) + 2  # +1 null slot at 0
+            if size > numeric_dim_budget:
+                raise UnsupportedDimension(
+                    f"numeric dimension {col!r} range {size} exceeds dense "
+                    "budget")
+            labels = np.empty(size, object)
+            labels[0] = None
+            labels[1:] = np.arange(lo, hi + 1)
+            # ids = v - (lo - 1): value lo -> 1
+            return DimPlan(spec.name, size, labels, col, "numeric",
+                           offset_name=pool.add(int(lo) - 1, np.int64))
+        raise UnsupportedDimension(
+            f"cannot group by DOUBLE column {col!r} densely")
+    if isinstance(spec, ExtractionDimensionSpec):
+        col = spec.dimension
+        ex = spec.extraction_fn
+        if isinstance(ex, TimeFormatExtractionFn):
+            if col != TIME_COLUMN:
+                raise UnsupportedDimension(
+                    "timeFormat extraction only on __time")
+            plan, remap_name, values = compile_time_format(
+                ex.format, ex.time_zone, t_min, t_max, pool)
+            labels = np.array(values, object)
+            return DimPlan(spec.name, len(values), labels, None,
+                           "timeformat", remap_name=remap_name,
+                           time_plan=plan)
+        if col not in table.schema or table.schema[col] is not ColumnType.STRING:
+            raise UnsupportedDimension(
+                f"extraction dimension over non-string column {col!r}")
+        d = table.dictionaries[col]
+        fn = _extraction_callable(ex)
+        extracted = [None] + [fn(v) for v in d.values]
+        values = sorted({v for v in extracted if v is not None})
+        index = {v: i + 1 for i, v in enumerate(values)}
+        remap = np.asarray([0 if v is None else index[v] for v in extracted],
+                           np.int32)
+        labels = np.empty(len(values) + 1, object)
+        labels[0] = None
+        labels[1:] = values
+        return DimPlan(spec.name, len(values) + 1, labels, col, "remap",
+                       remap_name=pool.add(remap))
+    raise UnsupportedDimension(f"unknown dimension spec {type(spec).__name__}")

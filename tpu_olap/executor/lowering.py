@@ -1,0 +1,282 @@
+"""Query lowering: QuerySpec + TableSegments -> PhysicalPlan.
+
+The analog of DruidStrategy's physical planning + Druid's per-query engine
+setup (SURVEY.md §4.2), redesigned for XLA's trace-once model: the lowered
+kernel closure only reads literals from a named ConstPool dict, so one
+jitted program serves every query sharing the same *template* (same spec
+structure, different literals) — the compile-cache requirement that makes
+sub-500ms p50 possible (SURVEY.md §8.4 #3). Anything the dense device path
+can't express raises Unsupported*, which the planner treats as "not
+rewritable" -> fallback (SURVEY.md §2 property 2).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from tpu_olap.ir.interval import ETERNITY
+from tpu_olap.ir.query import (GroupByQuerySpec, ScanQuerySpec,
+                               SearchQuerySpec, SelectQuerySpec,
+                               TimeseriesQuerySpec, TopNQuerySpec)
+from tpu_olap.kernels.exprs import eval_expr
+from tpu_olap.kernels.filtereval import ConstPool, compile_filter
+from tpu_olap.kernels.groupby import (UnsupportedAggregation,
+                                      build_group_key, compile_aggregations,
+                                      group_reduce)
+from tpu_olap.kernels.timebucket import compile_granularity
+from tpu_olap.executor.dimplan import compile_dimension
+from tpu_olap.segments.segment import ColumnType, TIME_COLUMN
+
+
+@dataclass
+class PhysicalPlan:
+    query: object
+    table: object
+    kind: str                  # "agg" | "mask" (scan/select)
+    pool: ConstPool = None
+    kernel: object = None      # unjitted fn(env, valid, segmask, consts)
+    statics: tuple = ()        # part of the compile-cache key
+    dim_plans: list = field(default_factory=list)
+    bucket_plan: object = None
+    agg_plans: list = field(default_factory=list)
+    sizes: tuple = ()          # (n_buckets, dim sizes...) radix order
+    total_groups: int = 1
+    pruned_ids: list = field(default_factory=list)
+    t_min: int = 0
+    t_max: int = 0
+    empty: bool = False        # intervals don't touch the table at all
+    columns: tuple = ()        # physical columns the kernel reads
+    null_cols: tuple = ()
+    virtual_exprs: dict = field(default_factory=dict)
+
+    def fingerprint(self) -> tuple:
+        import json
+        t = _template(self.query.to_json())
+        return (self.table.name, json.dumps(t, sort_keys=True), self.statics)
+
+
+_LITERAL_KEYS = {"value", "values", "lower", "upper", "pattern", "intervals"}
+
+
+def _template(j):
+    """Strip literal values from a query-JSON tree, keep structure."""
+    if isinstance(j, dict):
+        return {k: ("?" if k in _LITERAL_KEYS else _template(v))
+                for k, v in j.items()}
+    if isinstance(j, list):
+        return [_template(x) for x in j]
+    return j
+
+
+def lower(query, table, config) -> PhysicalPlan:
+    if isinstance(query, (TimeseriesQuerySpec, GroupByQuerySpec,
+                          TopNQuerySpec)):
+        return _lower_agg(query, table, config)
+    if isinstance(query, (ScanQuerySpec, SelectQuerySpec)):
+        return _lower_mask(query, table, config)
+    if isinstance(query, SearchQuerySpec):
+        raise AssertionError("search queries lower via runner._run_search")
+    raise UnsupportedAggregation(
+        f"no device lowering for {type(query).__name__}")
+
+
+def _time_range(query, table):
+    intervals = query.intervals or (ETERNITY,)
+    t0, t1 = table.time_boundary
+    lo = max(t0, min(iv.start for iv in intervals))
+    hi = min(t1, max(iv.end for iv in intervals) - 1)
+    return intervals, lo, hi, hi < lo
+
+
+def _interval_mask_fn(intervals, t0, t1, pool):
+    """None if intervals cover the whole table; else fn(env,c)->mask."""
+    covered = any(iv.start <= t0 and iv.end > t1 for iv in intervals)
+    if covered:
+        return None
+    starts = pool.add(np.asarray([iv.start for iv in intervals], np.int64))
+    ends = pool.add(np.asarray([iv.end for iv in intervals], np.int64))
+
+    def fn(env, c):
+        t = env["cols"][TIME_COLUMN]
+        return ((t[..., None] >= c[starts]) & (t[..., None] < c[ends])
+                ).any(axis=-1)
+    return fn
+
+
+def _collect_columns(table, query, dim_plans, agg_plans, vexprs,
+                     need_time: bool):
+    cols: set[str] = set()
+    if query.filter is not None:
+        cols |= query.filter.columns()
+    for p in agg_plans:
+        cols |= set(p.fields)
+    for dp in dim_plans:
+        if dp.source_col:
+            cols.add(dp.source_col)
+    # expand virtual column references to their physical inputs
+    phys: set[str] = set()
+    for c in cols:
+        if c in vexprs:
+            phys |= vexprs[c].columns()
+        else:
+            phys.add(c)
+    # filters on agg-inside filters already included via p.fields? filtered
+    # agg filters reference columns through compile-time closures; collect
+    for a in query.aggregations if hasattr(query, "aggregations") else ():
+        from tpu_olap.ir.aggregations import FilteredAggregation
+        if isinstance(a, FilteredAggregation):
+            for c in a.filter.columns():
+                phys |= vexprs[c].columns() if c in vexprs else {c}
+    if need_time:
+        phys.add(TIME_COLUMN)
+    unknown = [c for c in phys if c not in table.schema]
+    if unknown:
+        from tpu_olap.kernels.filtereval import UnsupportedFilter
+        raise UnsupportedFilter(f"unknown columns {unknown}")
+    null_cols = tuple(sorted(
+        c for c in phys if table.schema[c] is not ColumnType.STRING))
+    return tuple(sorted(phys)), null_cols
+
+
+def _lower_agg(query, table, config) -> PhysicalPlan:
+    pool = ConstPool()
+    intervals, t_min, t_max, empty = _time_range(query, table)
+    vexprs = {v.name: v.expression for v in query.virtual_columns}
+
+    bucket_plan = compile_granularity(query.granularity, t_min, t_max, pool)
+
+    if isinstance(query, GroupByQuerySpec):
+        dim_specs = query.dimensions
+    elif isinstance(query, TopNQuerySpec):
+        dim_specs = (query.dimension,)
+    else:
+        dim_specs = ()
+    dim_plans = [compile_dimension(s, table, pool, t_min, t_max,
+                                   numeric_dim_budget=config.dense_group_budget)
+                 for s in dim_specs]
+
+    agg_plans = compile_aggregations(
+        query.aggregations, table, pool, vexprs,
+        long_dtype=config.long_dtype, double_dtype=config.double_dtype,
+        theta_k_cap=config.theta_k_cap)
+
+    filter_fn = (compile_filter(query.filter, table, pool, vexprs)
+                 if query.filter is not None else None)
+    imask_fn = _interval_mask_fn(intervals, *table.time_boundary, pool)
+
+    sizes = (bucket_plan.n_buckets,) + tuple(dp.size for dp in dim_plans)
+    total = 1
+    for s in sizes:
+        total *= s
+    if total > config.dense_group_budget:
+        raise UnsupportedAggregation(
+            f"group space {total} exceeds dense budget "
+            f"{config.dense_group_budget}")
+    if not config.enable_x64:
+        # sketch state is [groups × radix]; without 64-bit lanes the flat
+        # scatter index must fit int32
+        from tpu_olap.kernels.hll import NUM_REGISTERS
+        for p in agg_plans:
+            radix = NUM_REGISTERS if p.kind == "hll" else (
+                p.theta_k if p.kind == "theta" else 1)
+            if radix > 1 and total * radix > (1 << 31) - 1:
+                raise UnsupportedAggregation(
+                    f"sketch index space {total}×{radix} overflows int32 "
+                    "without x64")
+
+    need_time = (bucket_plan.kind != "all" or imask_fn is not None
+                 or any(dp.kind == "timeformat" for dp in dim_plans))
+    columns, null_cols = _collect_columns(table, query, dim_plans, agg_plans,
+                                          vexprs, need_time)
+    pruned = [s.meta.segment_id for s in table.prune(intervals)]
+
+    def kernel(env, valid, seg_mask, consts):
+        xp = np if isinstance(valid, np.ndarray) else _jnp()
+        flat = {c: a.reshape(-1) for c, a in env["cols"].items()}
+        nulls = {c: a.reshape(-1) for c, a in env["nulls"].items()}
+        for name, ex in vexprs.items():
+            flat[name] = eval_expr(ex, flat, xp)
+        fenv = {"cols": flat, "nulls": nulls}
+        mask = (valid & seg_mask[:, None]).reshape(-1)
+        if filter_fn is not None:
+            mask = mask & filter_fn(fenv, consts)
+        if imask_fn is not None:
+            mask = mask & imask_fn(fenv, consts)
+        ids, radix = [], []
+        if bucket_plan.kind != "all":
+            ids.append(bucket_plan.ids(flat[TIME_COLUMN], consts))
+            radix.append(sizes[0])
+        for dp, size in zip(dim_plans, sizes[1:]):
+            ids.append(dp.ids(fenv, consts, xp))
+            radix.append(size)
+        if ids:
+            key, _ = build_group_key(ids, radix, xp)
+        else:
+            key = xp.zeros(mask.shape, xp.int32)
+        return group_reduce(key, mask, fenv, agg_plans, total, consts)
+
+    statics = ("agg", sizes, bucket_plan.kind,
+               tuple(dp.kind for dp in dim_plans),
+               tuple((p.kind, p.name) for p in agg_plans),
+               filter_fn is not None, imask_fn is not None)
+
+    return PhysicalPlan(
+        query=query, table=table, kind="agg", pool=pool, kernel=kernel,
+        statics=statics, dim_plans=dim_plans, bucket_plan=bucket_plan,
+        agg_plans=agg_plans, sizes=sizes, total_groups=total,
+        pruned_ids=pruned, t_min=t_min, t_max=t_max, empty=empty,
+        columns=columns, null_cols=null_cols, virtual_exprs=vexprs)
+
+
+def _lower_mask(query, table, config) -> PhysicalPlan:
+    """Scan/Select: device computes the row mask; rows assemble host-side."""
+    pool = ConstPool()
+    intervals, t_min, t_max, empty = _time_range(query, table)
+    vexprs = {v.name: v.expression for v in query.virtual_columns}
+    filter_fn = (compile_filter(query.filter, table, pool, vexprs)
+                 if query.filter is not None else None)
+    imask_fn = _interval_mask_fn(intervals, *table.time_boundary, pool)
+
+    cols: set[str] = set()
+    if query.filter is not None:
+        cols |= query.filter.columns()
+    phys: set[str] = set()
+    for c in cols:
+        phys |= vexprs[c].columns() if c in vexprs else {c}
+    if imask_fn is not None:
+        phys.add(TIME_COLUMN)
+    unknown = [c for c in phys if c not in table.schema]
+    if unknown:
+        from tpu_olap.kernels.filtereval import UnsupportedFilter
+        raise UnsupportedFilter(f"unknown columns {unknown}")
+    null_cols = tuple(sorted(
+        c for c in phys if table.schema[c] is not ColumnType.STRING))
+
+    def kernel(env, valid, seg_mask, consts):
+        xp = np if isinstance(valid, np.ndarray) else _jnp()
+        flat = {c: a.reshape(-1) for c, a in env["cols"].items()}
+        nulls = {c: a.reshape(-1) for c, a in env["nulls"].items()}
+        for name, ex in vexprs.items():
+            flat[name] = eval_expr(ex, flat, xp)
+        fenv = {"cols": flat, "nulls": nulls}
+        mask = (valid & seg_mask[:, None]).reshape(-1)
+        if filter_fn is not None:
+            mask = mask & filter_fn(fenv, consts)
+        if imask_fn is not None:
+            mask = mask & imask_fn(fenv, consts)
+        return {"mask": mask}
+
+    statics = ("mask", filter_fn is not None, imask_fn is not None)
+    pruned = [s.meta.segment_id for s in table.prune(intervals)]
+    return PhysicalPlan(
+        query=query, table=table, kind="mask", pool=pool, kernel=kernel,
+        statics=statics, pruned_ids=pruned, t_min=t_min, t_max=t_max,
+        empty=empty, columns=tuple(sorted(phys)), null_cols=null_cols,
+        virtual_exprs=vexprs)
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp

@@ -1,0 +1,144 @@
+"""Host-side result finalization and assembly.
+
+The analog of the reference's DruidQueryResultIterator + Spark-side final
+aggregate (SURVEY.md §4.2's "JSON→row" hot loop) — except here the device
+hands back small dense group tables, so assembly is O(groups), not O(rows):
+finalize sketches, evaluate post-aggregations, apply having/limit, decode
+dimension ids to values, and render Druid-wire-shaped records.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpu_olap.ir import aggregations as A
+from tpu_olap.ir import having as H
+from tpu_olap.ir import postaggs as P
+from tpu_olap.kernels.hll import hll_estimate
+from tpu_olap.kernels.theta import theta_estimate
+from tpu_olap.utils import timeutil
+
+
+def agg_specs_by_name(aggs) -> dict:
+    out = {}
+    for a in aggs:
+        inner = a.aggregator if isinstance(a, A.FilteredAggregation) else a
+        out[inner.name] = inner
+    return out
+
+
+def finalize_aggs(partials: dict, agg_plans, specs_by_name) -> dict:
+    """Device partials -> {name: np array [K]} of final values.
+
+    Sketches are finalized to numeric estimates here (Druid finalizes at
+    the broker; our 'broker' is this host step). min/max of empty groups
+    become NaN (rendered as null); sums/counts of empty groups are 0.
+    """
+    out = {"_rows": np.asarray(partials["_rows"])}
+    for p in agg_plans:
+        v = np.asarray(partials[p.name])
+        if p.kind in ("count", "sum"):
+            out[p.name] = v
+            if f"_nn_{p.name}" in partials:
+                out[f"_nn_{p.name}"] = np.asarray(partials[f"_nn_{p.name}"])
+            continue
+        if p.kind in ("min", "max"):
+            nn = np.asarray(partials[f"_nn_{p.name}"])
+            fv = v.astype(np.float64)
+            out[p.name] = np.where(nn > 0, fv, np.nan)
+            continue
+        if p.kind == "hll":
+            est = hll_estimate(v)
+            spec = specs_by_name.get(p.name)
+            if getattr(spec, "round", True):
+                est = np.round(est)
+            out[p.name] = est
+            continue
+        if p.kind == "theta":
+            out[p.name] = theta_estimate(v)
+            continue
+        raise AssertionError(p.kind)
+    return out
+
+
+def eval_post_aggs(arrays: dict, post_aggs) -> None:
+    """Add post-aggregation outputs to `arrays` (in dependency order —
+    Druid allows referencing earlier post-aggs)."""
+    for pa in post_aggs:
+        arrays[pa.name] = _eval_pa(pa, arrays)
+
+
+def _eval_pa(pa, arrays):
+    if isinstance(pa, P.FieldAccessPostAgg):
+        return np.asarray(arrays[pa.field_name], np.float64)
+    if isinstance(pa, P.ConstantPostAgg):
+        return np.float64(pa.value)
+    if isinstance(pa, (P.HyperUniqueCardinalityPostAgg,
+                       P.ThetaSketchEstimatePostAgg)):
+        # sketches are already finalized to numbers in finalize_aggs
+        return np.asarray(arrays[pa.field_name], np.float64)
+    if isinstance(pa, P.ArithmeticPostAgg):
+        vals = [_eval_pa(f, arrays) for f in pa.fields]
+        out = np.asarray(vals[0], np.float64)
+        for v in vals[1:]:
+            if pa.fn in ("/", "quotient"):
+                # Druid arithmetic division yields 0 on division by zero
+                with np.errstate(divide="ignore", invalid="ignore"):
+                    out = np.where(v != 0, out / np.where(v != 0, v, 1), 0.0)
+            elif pa.fn == "+":
+                out = out + v
+            elif pa.fn == "-":
+                out = out - v
+            elif pa.fn == "*":
+                out = out * v
+            else:
+                raise ValueError(f"unknown post-agg fn {pa.fn!r}")
+        return out
+    raise ValueError(f"unknown post-agg {type(pa).__name__}")
+
+
+def eval_having(spec, arrays: dict, dim_values: dict) -> np.ndarray:
+    """HavingSpec -> bool mask over groups. dim_values: name -> object
+    array of decoded dimension values per group row."""
+    if isinstance(spec, H.GreaterThanHaving):
+        return np.asarray(arrays[spec.aggregation], np.float64) > spec.value
+    if isinstance(spec, H.LessThanHaving):
+        return np.asarray(arrays[spec.aggregation], np.float64) < spec.value
+    if isinstance(spec, H.EqualToHaving):
+        return np.asarray(arrays[spec.aggregation], np.float64) == spec.value
+    if isinstance(spec, H.DimSelectorHaving):
+        vals = dim_values[spec.dimension]
+        return np.asarray([v == spec.value for v in vals])
+    if isinstance(spec, H.AndHaving):
+        out = None
+        for h in spec.having_specs:
+            m = eval_having(h, arrays, dim_values)
+            out = m if out is None else out & m
+        return out
+    if isinstance(spec, H.OrHaving):
+        out = None
+        for h in spec.having_specs:
+            m = eval_having(h, arrays, dim_values)
+            out = m if out is None else out | m
+        return out
+    if isinstance(spec, H.NotHaving):
+        return ~eval_having(spec.having_spec, arrays, dim_values)
+    raise ValueError(f"unknown having {type(spec).__name__}")
+
+
+def render_value(v):
+    """numpy -> plain-JSON value; NaN -> None (SQL null)."""
+    if v is None:
+        return None
+    if isinstance(v, (np.floating, float)):
+        f = float(v)
+        return None if np.isnan(f) else f
+    if isinstance(v, np.integer):
+        return int(v)
+    if isinstance(v, np.bool_):
+        return bool(v)
+    return v
+
+
+def iso(ms: int) -> str:
+    return timeutil.millis_to_iso(int(ms))

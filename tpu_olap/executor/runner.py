@@ -1,0 +1,455 @@
+"""QueryRunner: execute a QuerySpec against a registered table.
+
+The analog of the reference's DruidRDD.compute + broker round-trip
+(SURVEY.md §4.2) collapsed into an in-process call: lower -> (cached) jit
+-> device dispatch -> host assembly. Per-query observability records
+(segments pruned, rows scanned, compile/execute/assemble times) mirror the
+reference's DruidQueryHistory (SURVEY.md §3.2 "Query-history").
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from tpu_olap.executor.config import EngineConfig
+from tpu_olap.executor.dataset import DeviceDataset
+from tpu_olap.executor.lowering import PhysicalPlan, lower
+from tpu_olap.executor.results import (agg_specs_by_name, eval_having,
+                                       eval_post_aggs, finalize_aggs, iso,
+                                       render_value)
+from tpu_olap.ir.query import (GroupByQuerySpec, ScanQuerySpec,
+                               SearchQuerySpec, SegmentMetadataQuerySpec,
+                               SelectQuerySpec, TimeBoundaryQuerySpec,
+                               TimeseriesQuerySpec, TopNQuerySpec)
+from tpu_olap.ir.interval import ETERNITY
+from tpu_olap.ir.aggregations import CountAggregation
+from tpu_olap.ir.dimensions import DefaultDimensionSpec
+from tpu_olap.segments.segment import TIME_COLUMN
+
+
+@dataclass
+class QueryResult:
+    query: object
+    rows: list                 # flat records (dims/aggs/postaggs [+timestamp])
+    druid: list                # Druid-wire-shaped result
+    metrics: dict = field(default_factory=dict)
+
+    def to_pandas(self):
+        import pandas as pd
+        return pd.DataFrame(self.rows)
+
+
+class QueryRunner:
+    def __init__(self, config: EngineConfig | None = None):
+        self.config = config or EngineConfig()
+        self.config.apply_x64()
+        self._datasets: dict = {}
+        self._jit_cache: dict = {}
+        self.history: list = []
+
+    # ------------------------------------------------------------------ API
+
+    def execute(self, query, table) -> QueryResult:
+        t0 = time.perf_counter()
+        if isinstance(query, TimeBoundaryQuerySpec):
+            res = self._run_time_boundary(query, table)
+        elif isinstance(query, SegmentMetadataQuerySpec):
+            res = self._run_segment_metadata(query, table)
+        elif isinstance(query, SearchQuerySpec):
+            res = self._run_search(query, table)
+        elif isinstance(query, (ScanQuerySpec, SelectQuerySpec)):
+            res = self._run_scan(query, table)
+        elif isinstance(query, (TimeseriesQuerySpec, GroupByQuerySpec,
+                                TopNQuerySpec)):
+            res = self._run_agg(query, table)
+        else:
+            raise TypeError(f"unknown query type {type(query).__name__}")
+        res.metrics["total_ms"] = (time.perf_counter() - t0) * 1000
+        res.metrics["query_type"] = query.query_type
+        res.metrics["datasource"] = table.name
+        self.history.append(res.metrics)
+        return res
+
+    def clear_cache(self, table_name: str | None = None):
+        """Evict device-resident columns (+ compiled programs if full clear).
+        The analog of `CLEAR DRUID CACHE` (SURVEY.md §4.5)."""
+        if table_name is None:
+            for ds in self._datasets.values():
+                ds.evict()
+            self._datasets.clear()
+            self._jit_cache.clear()
+        elif table_name in self._datasets:
+            self._datasets.pop(table_name).evict()
+            self._jit_cache = {k: v for k, v in self._jit_cache.items()
+                               if k[0] != table_name}
+
+    # ------------------------------------------------------------- dispatch
+
+    def _dataset(self, table) -> DeviceDataset:
+        key = table.name
+        ds = self._datasets.get(key)
+        if ds is None or ds.table is not table:
+            ds = DeviceDataset(table, self.config.platform)
+            self._datasets[key] = ds
+        return ds
+
+    def _run_partials(self, plan: PhysicalPlan, metrics: dict) -> dict:
+        table = plan.table
+        ds = self._dataset(table)
+        env = ds.env(plan.columns, plan.null_cols)
+        valid = ds.valid()
+        seg_mask = ds.segment_mask(plan.pruned_ids if not plan.empty else [])
+        metrics["segments_total"] = len(table.segments)
+        metrics["segments_scanned"] = int(seg_mask.sum())
+        metrics["rows_scanned"] = int(sum(
+            table.segments[i].meta.n_valid for i in plan.pruned_ids)) \
+            if not plan.empty else 0
+
+        if self.config.platform == "cpu":
+            t0 = time.perf_counter()
+            out = plan.kernel(env, np.asarray(valid), seg_mask,
+                              plan.pool.consts)
+            metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
+            metrics["cache_hit"] = False
+            return {k: np.asarray(v) for k, v in out.items()}
+
+        import jax
+        key = plan.fingerprint()
+        jitted = self._jit_cache.get(key)
+        hit = jitted is not None
+        if not hit:
+            jitted = jax.jit(plan.kernel)
+            self._jit_cache[key] = jitted
+        t0 = time.perf_counter()
+        out = jitted(env, valid, jax.device_put(seg_mask), plan.pool.consts)
+        out = {k: np.asarray(v) for k, v in out.items()}
+        metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
+        metrics["cache_hit"] = hit
+        return out
+
+    # ------------------------------------------------------------ agg paths
+
+    def _run_agg(self, query, table) -> QueryResult:
+        metrics = {}
+        t0 = time.perf_counter()
+        plan = lower(query, table, self.config)
+        metrics["lower_ms"] = (time.perf_counter() - t0) * 1000
+        partials = self._run_partials(plan, metrics)
+
+        t0 = time.perf_counter()
+        specs = agg_specs_by_name(query.aggregations)
+        arrays = finalize_aggs(partials, plan.agg_plans, specs)
+        eval_post_aggs(arrays, query.post_aggregations)
+        if isinstance(query, TimeseriesQuerySpec):
+            res = self._assemble_timeseries(query, plan, arrays)
+        elif isinstance(query, GroupByQuerySpec):
+            res = self._assemble_groupby(query, plan, arrays)
+        else:
+            res = self._assemble_topn(query, plan, arrays)
+        res.metrics = metrics
+        metrics["assemble_ms"] = (time.perf_counter() - t0) * 1000
+        return res
+
+    def _out_names(self, query):
+        names = [a.name for a in query.aggregations]
+        names += [p.name for p in query.post_aggregations]
+        return names
+
+    def _bucket_emit_ids(self, query, plan):
+        """Bucket ids to emit, honoring intervals and descending order."""
+        if plan.empty:
+            return []
+        intervals = query.intervals or (ETERNITY,)
+        starts = plan.bucket_plan.starts
+        ids = [b for b in range(plan.bucket_plan.n_buckets)
+               if any(iv.overlaps(int(starts[b]),
+                                  int(starts[b + 1])
+                                  if b + 1 < len(starts) else plan.t_max + 1)
+                      for iv in intervals)]
+        return ids
+
+    def _assemble_timeseries(self, query, plan, arrays) -> QueryResult:
+        names = self._out_names(query)
+        rows, druid = [], []
+        skip_empty = bool(dict(query.context).get(
+            "skipEmptyBuckets", self.config.skip_empty_buckets))
+        bucket_ids = self._bucket_emit_ids(query, plan)
+        if query.descending:
+            bucket_ids = bucket_ids[::-1]
+        present = arrays["_rows"] > 0
+        for b in bucket_ids:
+            if skip_empty and not present[b]:
+                continue
+            vals = {n: render_value(arrays[n][b]) for n in names}
+            ts = iso(plan.bucket_plan.starts[b])
+            rows.append({"timestamp": ts, **vals})
+            druid.append({"timestamp": ts, "result": vals})
+        return QueryResult(query, rows, druid)
+
+    def _decode_groups(self, plan, idx: np.ndarray):
+        """Present flat group ids -> (bucket ids, {dim name -> values})."""
+        sizes = plan.sizes
+        rem = idx
+        radix_vals = []
+        for s in sizes[::-1]:
+            radix_vals.append(rem % s)
+            rem = rem // s
+        radix_vals = radix_vals[::-1]  # bucket first, then dims in order
+        buckets = radix_vals[0]
+        dim_vals = {}
+        for dp, ids in zip(plan.dim_plans, radix_vals[1:]):
+            dim_vals[dp.name] = dp.labels[ids]
+        return buckets, dim_vals
+
+    def _assemble_groupby(self, query, plan, arrays) -> QueryResult:
+        names = self._out_names(query)
+        present = np.nonzero(arrays["_rows"] > 0)[0]
+        buckets, dim_vals = self._decode_groups(plan, present)
+        sub = {n: np.asarray(arrays[n])[present] for n in names}
+
+        if query.having is not None:
+            hmask = eval_having(query.having, sub, dim_vals)
+            present = present[hmask]
+            buckets = buckets[hmask]
+            dim_vals = {k: v[hmask] for k, v in dim_vals.items()}
+            sub = {k: v[hmask] for k, v in sub.items()}
+
+        order = np.arange(len(present))
+        ls = query.limit_spec
+        if ls is not None and ls.columns:
+            keys = []
+            for c in ls.columns[::-1]:
+                if c.dimension in dim_vals:
+                    v = dim_vals[c.dimension]
+                    k = np.asarray([("" if x is None else str(x)) for x in v])
+                    if c.dimension_order == "numeric":
+                        k = np.asarray([float(x) if x else -np.inf for x in k])
+                else:
+                    k = np.asarray(sub[c.dimension], np.float64)
+                if c.direction == "descending":
+                    k = _invert_sort_key(k)
+                keys.append(k)
+            order = np.lexsort(keys)
+        if ls is not None:
+            lo = ls.offset
+            hi = None if ls.limit is None else lo + ls.limit
+            order = order[lo:hi]
+
+        rows, druid = [], []
+        starts = plan.bucket_plan.starts
+        for i in order:
+            ts = iso(starts[buckets[i]])
+            ev = {dp.name: render_value(dim_vals[dp.name][i])
+                  for dp in plan.dim_plans}
+            ev.update({n: render_value(sub[n][i]) for n in names})
+            rows.append({"timestamp": ts, **ev})
+            druid.append({"version": "v1", "timestamp": ts, "event": ev})
+        return QueryResult(query, rows, druid)
+
+    def _assemble_topn(self, query, plan, arrays) -> QueryResult:
+        names = self._out_names(query)
+        n_b = plan.sizes[0]
+        d_size = plan.sizes[1]
+        metric = np.asarray(arrays[query.metric], np.float64) \
+            .reshape(n_b, d_size)
+        present = (arrays["_rows"] > 0).reshape(n_b, d_size)
+        dp = plan.dim_plans[0]
+        rows, druid = [], []
+        for b in self._bucket_emit_ids(query, plan):
+            m = np.where(present[b],
+                         -metric[b] if query.inverted else metric[b],
+                         -np.inf)
+            order = np.argsort(-m, kind="stable")
+            order = order[m[order] > -np.inf][:query.threshold]
+            ts = iso(plan.bucket_plan.starts[b])
+            result = []
+            for g in order:
+                flat = b * d_size + g
+                ev = {dp.name: render_value(dp.labels[g])}
+                ev.update({n: render_value(np.asarray(arrays[n])[flat])
+                           for n in names})
+                result.append(ev)
+                rows.append({"timestamp": ts, **ev})
+            druid.append({"timestamp": ts, "result": result})
+        return QueryResult(query, rows, druid)
+
+    # ----------------------------------------------------------- scan paths
+
+    def _run_scan(self, query, table) -> QueryResult:
+        metrics = {}
+        t0 = time.perf_counter()
+        plan = lower(query, table, self.config)
+        metrics["lower_ms"] = (time.perf_counter() - t0) * 1000
+        partials = self._run_partials(plan, metrics)
+        mask = partials["mask"].reshape(len(table.segments),
+                                        table.block_rows)
+
+        t0 = time.perf_counter()
+        if isinstance(query, ScanQuerySpec):
+            cols = list(query.columns) if query.columns else \
+                [c for c in table.schema]
+            offset, limit = query.offset, query.limit
+            descending = query.order == "descending"
+        else:
+            dims = list(query.dimensions) or [
+                c for c, t in table.schema.items() if t.is_dim]
+            mets = list(query.metrics) or [
+                c for c, t in table.schema.items()
+                if not t.is_dim and c != TIME_COLUMN]
+            cols = [TIME_COLUMN] + dims + mets
+            offset, limit = query.paging_offset, query.page_size
+            descending = query.descending
+
+        events = self._gather_rows(table, mask, cols, offset, limit,
+                                   descending)
+        metrics["assemble_ms"] = (time.perf_counter() - t0) * 1000
+
+        if isinstance(query, ScanQuerySpec):
+            druid = [{"columns": cols, "events": events}]
+            res = QueryResult(query, events, druid)
+        else:
+            druid = [{
+                "timestamp": iso(plan.t_min),
+                "result": {
+                    "pagingIdentifiers": {"offset": offset + len(events)},
+                    "events": [{"offset": offset + i, "event": e}
+                               for i, e in enumerate(events)],
+                },
+            }]
+            res = QueryResult(query, events, druid)
+        res.metrics = metrics
+        return res
+
+    def _gather_rows(self, table, mask, cols, offset, limit, descending):
+        seg_iter = table.segments[::-1] if descending else table.segments
+        events = []
+        skipped = 0
+        budget = None if limit is None else offset + limit
+        for s in seg_iter:
+            m = mask[s.meta.segment_id]
+            idx = np.nonzero(m)[0]
+            if descending:
+                idx = idx[::-1]
+            if idx.size == 0:
+                continue
+            if budget is not None and skipped + len(events) + idx.size \
+                    > budget:
+                idx = idx[:budget - skipped - len(events)]
+            take = idx
+            if skipped < offset:
+                drop = min(offset - skipped, take.size)
+                skipped += drop
+                take = take[drop:]
+            if take.size:
+                decoded = {}
+                for c in cols:
+                    v = s.columns[c][take]
+                    d = table.dictionaries.get(c)
+                    if d is not None:
+                        decoded[c] = d.decode(v)
+                    else:
+                        nm = s.null_masks.get(c)
+                        vals = [render_value(x) for x in v]
+                        if nm is not None:
+                            vals = [None if nm[i] else x
+                                    for i, x in zip(take, vals)]
+                        decoded[c] = vals
+                for r in range(take.size):
+                    events.append({c: render_value(decoded[c][r])
+                                   for c in cols})
+            if budget is not None and skipped + len(events) >= budget:
+                break
+        return events
+
+    # ------------------------------------------------------------- metadata
+
+    def _run_search(self, query, table) -> QueryResult:
+        dims = list(query.search_dimensions) or [
+            c for c, t in table.schema.items() if t.is_dim]
+        matcher = _search_matcher(query.query)
+        hits = []
+        for dim in dims:
+            inner = GroupByQuerySpec(
+                data_source=query.data_source,
+                intervals=query.intervals,
+                filter=query.filter,
+                virtual_columns=query.virtual_columns,
+                dimensions=(DefaultDimensionSpec(dim),),
+                aggregations=(CountAggregation("count"),),
+            )
+            res = self._run_agg(inner, table)
+            for r in res.rows:
+                v = r[dim]
+                if v is not None and matcher(v):
+                    hits.append({"dimension": dim, "value": v,
+                                 "count": int(r["count"])})
+        hits.sort(key=lambda h: (_search_sort_key(query.sort, h["value"]),
+                                 h["dimension"]))
+        hits = hits[:query.limit]
+        t0, _ = table.time_boundary
+        druid = [{"timestamp": iso(t0), "result": hits}]
+        return QueryResult(query, hits, druid)
+
+    def _run_time_boundary(self, query, table) -> QueryResult:
+        t0, t1 = table.time_boundary
+        intervals = query.intervals or (ETERNITY,)
+        lo = max(t0, min(iv.start for iv in intervals))
+        hi = min(t1, max(iv.end for iv in intervals) - 1)
+        result = {}
+        if query.bound in (None, "minTime"):
+            result["minTime"] = iso(lo)
+        if query.bound in (None, "maxTime"):
+            result["maxTime"] = iso(hi)
+        druid = [{"timestamp": iso(lo), "result": result}]
+        return QueryResult(query, [result], druid)
+
+    def _run_segment_metadata(self, query, table) -> QueryResult:
+        cols = table.column_metadata(set(query.to_include) or None)
+        t0, t1 = table.time_boundary
+        record = {
+            "id": f"{table.name}_merged",
+            "intervals": [f"{iso(t0)}/{iso(t1 + 1)}"],
+            "columns": cols,
+            "numRows": table.num_rows,
+            "size": int(sum(c.get("size", 0) for c in cols.values())),
+        }
+        return QueryResult(query, [record], [record])
+
+
+def _invert_sort_key(k: np.ndarray):
+    if k.dtype.kind in "fiu":
+        return -k.astype(np.float64)
+    # lexicographic descending for strings: invert via codes trick
+    uniq, inv = np.unique(k, return_inverse=True)
+    return -inv
+
+
+def _search_sort_key(sort: str, value: str):
+    if sort == "strlen":
+        return (len(value), value)
+    if sort == "alphanumeric":
+        # natural order: digit runs compare numerically
+        import re
+        parts = re.split(r"(\d+)", value)
+        return tuple((1, int(p)) if p.isdigit() else (0, p)
+                     for p in parts if p != "")
+    return value  # lexicographic
+
+
+def _search_matcher(sq):
+    if sq.fragments:
+        frags = [f if sq.case_sensitive else f.lower() for f in sq.fragments]
+
+        def m(v):
+            s = v if sq.case_sensitive else v.lower()
+            return all(f in s for f in frags)
+        return m
+    needle = sq.value if sq.case_sensitive else sq.value.lower()
+
+    def m(v):
+        s = v if sq.case_sensitive else v.lower()
+        return needle in s
+    return m

@@ -90,12 +90,22 @@ def compile_filter(spec, table, pool: ConstPool, virtual_exprs=None):
             return lambda env, c: ~fn(env, c)
         if isinstance(s, F.ExpressionFilter):
             expr = s.expression
+            phys = set()
             for col in expr.columns():
                 if col_type(col) is ColumnType.STRING:
                     raise UnsupportedFilter(
                         f"expression filter over string column {col!r}")
-            return lambda env, c: eval_expr(
-                expr, numeric_env(env), jnp if _is_jax(env) else np) != 0
+                phys |= (virtual_exprs[col].columns()
+                         if col in virtual_exprs else {col})
+
+            def fn(env, c):
+                m = eval_expr(expr, numeric_env(env),
+                              jnp if _is_jax(env) else np) != 0
+                # NULL in any referenced input -> no match (boolean, not 3VL)
+                for col in phys:
+                    m = m & ~_null_mask(env, col)
+                return m
+            return fn
         raise UnsupportedFilter(f"cannot lower filter {type(s).__name__}")
 
     # ---- leaf lowerers ---------------------------------------------------
@@ -119,9 +129,10 @@ def compile_filter(spec, table, pool: ConstPool, virtual_exprs=None):
         # numeric
         if s.value is None:
             return lambda env, c: _null_mask(env, col)
-        cval = pool.add(float(s.value) if typ is ColumnType.DOUBLE
-                        else int(s.value),
-                        np.float64 if typ is ColumnType.DOUBLE else np.int64)
+        val = _parse_num(s.value, typ)
+        if val is None:
+            return _never(col)  # Druid: unparseable literal matches nothing
+        cval = pool.add(val)
         return lambda env, c: ((env["cols"][col] == c[cval])
                                & ~_null_mask(env, col))
 
@@ -136,16 +147,23 @@ def compile_filter(spec, table, pool: ConstPool, virtual_exprs=None):
                     lambda v: _numeric_in_bound(v, s))
                 cname = pool.add(tbl)
                 return lambda env, c: c[cname][env["cols"][col]]
-            dtype = np.float64 if typ is ColumnType.DOUBLE else np.int64
             parts = []
             if s.lower is not None:
-                clo = pool.add(dtype(s.lower))
+                lo = _parse_num(s.lower, typ)
+                if lo is None:
+                    raise UnsupportedFilter(
+                        f"non-numeric bound literal {s.lower!r} on {col!r}")
+                clo = pool.add(lo)
                 if s.lower_strict:
                     parts.append(lambda env, c: env["cols"][col] > c[clo])
                 else:
                     parts.append(lambda env, c: env["cols"][col] >= c[clo])
             if s.upper is not None:
-                chi = pool.add(dtype(s.upper))
+                hi = _parse_num(s.upper, typ)
+                if hi is None:
+                    raise UnsupportedFilter(
+                        f"non-numeric bound literal {s.upper!r} on {col!r}")
+                chi = pool.add(hi)
                 if s.upper_strict:
                     parts.append(lambda env, c: env["cols"][col] < c[chi])
                 else:
@@ -167,9 +185,12 @@ def compile_filter(spec, table, pool: ConstPool, virtual_exprs=None):
             d = table.dictionaries[col]
             cname = pool.add(d.in_table(s.values))
             return lambda env, c: c[cname][env["cols"][col]]
-        dtype = np.float64 if typ is ColumnType.DOUBLE else np.int64
+        parsed = [_parse_num(v, typ) for v in s.values if v is not None]
+        parsed = [v for v in parsed if v is not None]
+        any_float = any(isinstance(v, np.floating) for v in parsed)
         vals = pool.add(np.asarray(
-            [v for v in s.values if v is not None], dtype=dtype))
+            parsed, dtype=np.float64 if any_float or typ is ColumnType.DOUBLE
+            else np.int64))
         has_null = any(v is None for v in s.values)
 
         def fn(env, c):
@@ -192,6 +213,35 @@ def compile_filter(spec, table, pool: ConstPool, virtual_exprs=None):
 
 
 # ---------------------------------------------------------------------------
+
+
+def _parse_num(value, typ):
+    """Literal -> numeric scalar in the column's natural width, widening to
+    float64 for fractional literals on LONG columns (comparison promotes);
+    None if the literal isn't numeric at all (Druid: matches nothing)."""
+    if typ is ColumnType.DOUBLE:
+        try:
+            return np.float64(value)
+        except (TypeError, ValueError):
+            return None
+    if isinstance(value, (float, np.floating)):
+        return np.int64(value) if float(value).is_integer() \
+            else np.float64(value)
+    try:
+        return np.int64(value)
+    except (TypeError, ValueError, OverflowError):
+        try:
+            return np.float64(value)
+        except (TypeError, ValueError):
+            return None
+
+
+def _never(col):
+    def fn(env, c):
+        x = env["cols"][col]
+        xp = np if isinstance(x, np.ndarray) else jnp
+        return xp.zeros(x.shape, bool)
+    return fn
 
 
 def _null_mask(env, col):

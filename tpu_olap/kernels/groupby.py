@@ -37,6 +37,12 @@ class AggPlan:
     filter_fn: object = None   # compiled FilterSpec for filtered aggs
     theta_k: int = 0
     is_string_input: tuple = ()  # per-field: True if dict codes
+    by_row: bool = True  # multi-field HLL: distinct tuples (True) vs
+    #                      union of per-field value sets (Druid byRow=False)
+    hash_tables: tuple = ()  # per-field ConstPool name of the value-hash
+    #                          table for string fields (None for numeric):
+    #                          hashing VALUES (not codes) keeps hashes
+    #                          consistent across dictionaries/fields
 
 
 def compile_aggregations(aggs, table, pool, virtual_exprs=None,
@@ -82,24 +88,49 @@ def compile_aggregations(aggs, table, pool, virtual_exprs=None,
             return AggPlan(spec.name, "hll", fields, np.int32, filter_fn,
                            is_string_input=tuple(
                                field_type(f) is ColumnType.STRING
-                               for f in fields))
+                               for f in fields),
+                           by_row=spec.by_row,
+                           hash_tables=_hash_tables(fields, table, pool,
+                                                    field_type))
         if isinstance(spec, A.HyperUniqueAggregation):
-            return AggPlan(spec.name, "hll", (spec.field_name,), np.int32,
-                           filter_fn,
+            f = (spec.field_name,)
+            return AggPlan(spec.name, "hll", f, np.int32, filter_fn,
                            is_string_input=(field_type(spec.field_name)
-                                            is ColumnType.STRING,))
+                                            is ColumnType.STRING,),
+                           hash_tables=_hash_tables(f, table, pool,
+                                                    field_type))
         if isinstance(spec, A.ThetaSketchAggregation):
             k = min(int(spec.size), theta_k_cap)
-            return AggPlan(spec.name, "theta", (spec.field_name,),
+            f = (spec.field_name,)
+            return AggPlan(spec.name, "theta", f,
                            np.float64, filter_fn, theta_k=k,
                            is_string_input=(field_type(spec.field_name)
-                                            is ColumnType.STRING,))
+                                            is ColumnType.STRING,),
+                           hash_tables=_hash_tables(f, table, pool,
+                                                    field_type))
         raise UnsupportedAggregation(
             f"cannot lower aggregation {type(spec).__name__}")
 
     for a in aggs:
         plans.append(lower(a))
     return plans
+
+
+def _hash_tables(fields, table, pool, field_type):
+    """Per-field value-hash const tables for string fields (None slots for
+    numeric fields). table[0] (null) is 0 — nulls are masked out anyway."""
+    import zlib
+    out = []
+    for f in fields:
+        if field_type(f) is ColumnType.STRING:
+            d = table.dictionaries[f]
+            t = np.zeros(d.size + 1, np.int32)
+            for i, v in enumerate(d.values):
+                t[i + 1] = np.int32(zlib.crc32(v.encode()) & 0x7FFFFFFF)
+            out.append(pool.add(t))
+        else:
+            out.append(None)
+    return tuple(out)
 
 
 def build_group_key(ids, sizes, xp):
@@ -167,13 +198,28 @@ def group_reduce(key, mask, env, plans, num_groups, consts):
                                             num_groups, xp)
             continue
         if p.kind == "hll":
-            h, valid = _hash_fields(env, p, m, xp)
-            out[p.name] = hll_mod.hll_update(h, valid,
-                                             xp.where(valid, key, 0),
-                                             num_groups, xp)
+            if p.by_row or len(p.fields) <= 1:
+                h, valid = _hash_fields(env, p, m, xp, consts)
+                out[p.name] = hll_mod.hll_update(h, valid,
+                                                 xp.where(valid, key, 0),
+                                                 num_groups, xp)
+            else:
+                # Druid byRow=False: distinct over the UNION of each
+                # field's values — update once per field, max-merge
+                regs = None
+                for i, f in enumerate(p.fields):
+                    sub = AggPlan(p.name, "hll", (f,), p.acc_dtype,
+                                  is_string_input=(p.is_string_input[i],),
+                                  hash_tables=(p.hash_tables[i],))
+                    h, valid = _hash_fields(env, sub, m, xp, consts)
+                    r = hll_mod.hll_update(h, valid,
+                                           xp.where(valid, key, 0),
+                                           num_groups, xp)
+                    regs = r if regs is None else xp.maximum(regs, r)
+                out[p.name] = regs
             continue
         if p.kind == "theta":
-            h, valid = _hash_fields(env, p, m, xp)
+            h, valid = _hash_fields(env, p, m, xp, consts)
             out[p.name] = theta_mod.theta_update(h, valid, key, num_groups,
                                                  p.theta_k, xp)
             continue
@@ -237,18 +283,19 @@ def _ident(dtype, kind):
     return dt.type(info.max if kind == "min" else info.min)
 
 
-def _hash_fields(env, p: AggPlan, mask, xp):
+def _hash_fields(env, p: AggPlan, mask, xp, consts):
     """Rows -> 32-bit hashes of the (possibly multi-)field value; valid
-    excludes SQL-null inputs (nulls don't count toward COUNT DISTINCT)."""
+    excludes SQL-null inputs (nulls don't count toward COUNT DISTINCT).
+    String fields hash their dictionary VALUES via host-built tables."""
     from tpu_olap.kernels.hashing import hash32_int, hash_combine
 
     h = None
     valid = mask
-    for f, is_code in zip(p.fields, p.is_string_input):
+    for f, is_code, tbl in zip(p.fields, p.is_string_input, p.hash_tables):
         x = env["cols"][f]
         if is_code:
             valid = valid & (x > 0)  # code 0 = null
-            hx = hash32_int(x.astype(xp.int32), xp)
+            hx = hash32_int(consts[tbl][x], xp)
         else:
             nulls = env["nulls"].get(f)
             if nulls is not None:

@@ -42,7 +42,12 @@ def hll_update(h, valid, key, num_groups, xp):
                         jax.lax.clz(shifted.astype(jnp.int32)) + 1
                         ).astype(jnp.int32)
     rho = xp.where(valid, rho, 0)
-    flat = key.astype(xp.int32) * np.int32(NUM_REGISTERS) + reg
+    # index space is groups × 2048: compute in the widest int available so
+    # group counts inside the dense budget can't overflow the flat index
+    # (callers guard the x64-off case — see lowering's sketch radix check)
+    idx_dtype = xp.int64 if _wide_ints(xp) else xp.int32
+    flat = key.astype(idx_dtype) * idx_dtype(NUM_REGISTERS) \
+        + reg.astype(idx_dtype)
     flat = xp.where(valid, flat, 0)
     if xp is np:
         regs = np.zeros(num_groups * NUM_REGISTERS, np.int32)
@@ -56,6 +61,11 @@ def hll_update(h, valid, key, num_groups, xp):
 
 def hll_merge(a, b, xp):
     return xp.maximum(a, b)
+
+
+def _wide_ints(xp) -> bool:
+    from tpu_olap.kernels.hashing import has_x64
+    return has_x64(xp)
 
 
 def hll_estimate(registers: np.ndarray) -> np.ndarray:

@@ -37,13 +37,14 @@ def theta_update(h, valid, key, num_groups, k, xp):
         first = xp.concatenate([first[:1], ~dup])
     kept = first & (gs < num_groups) & (us < EMPTY)
     # rank of each kept row within its group
-    idx = xp.arange(gs.shape[0])
     prefix = xp.cumsum(kept.astype(xp.int32)) - kept.astype(xp.int32)
     start = _seg_min(xp.where(kept, prefix, np.int32(2**31 - 1)), gs,
                      num_groups + 1, xp)
     rank = prefix - start[gs]
     ok = kept & (rank < k)
-    flat = xp.where(ok, gs * np.int32(k) + rank.astype(xp.int32), 0)
+    from tpu_olap.kernels.hashing import has_x64
+    idt = xp.int64 if has_x64(xp) else xp.int32
+    flat = xp.where(ok, gs.astype(idt) * idt(k) + rank.astype(idt), 0)
     vals = xp.where(ok, us, EMPTY)
     table = _scatter_min(vals, flat, num_groups * k, xp)
     return table.reshape(num_groups, k)

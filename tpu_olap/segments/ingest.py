@@ -43,6 +43,9 @@ def ingest_arrow(name: str, table, time_column: str | None = None,
 
     # ---- time column -> __time (epoch millis int64) ----------------------
     n = table.num_rows
+    if time_column is None and TIME_COLUMN in table.schema.names:
+        # a Druid-exported table carries its own __time column; use it
+        time_column = TIME_COLUMN
     if time_column is not None:
         tcol = table.column(time_column)
         if tcol.null_count:
