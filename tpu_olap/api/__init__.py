@@ -1,0 +1,1 @@
+from tpu_olap.api.engine import Engine  # noqa: F401

@@ -1,0 +1,126 @@
+"""Engine — the L7 surface (SURVEY.md §3.1): table registration (the
+DefaultSource OPTIONS analog), SQL entry point with transparent fallback,
+EXPLAIN DRUID REWRITE, raw-IR passthrough (ON DRUID DATASOURCE ... EXECUTE
+QUERY), and CLEAR DRUID CACHE.
+"""
+
+from __future__ import annotations
+
+import pandas as pd
+
+from tpu_olap.catalog import Catalog, StarSchema, TableEntry
+from tpu_olap.executor import EngineConfig, QueryRunner
+from tpu_olap.executor.dimplan import UnsupportedDimension
+from tpu_olap.executor.runner import QueryResult
+from tpu_olap.ir.serde import query_from_json
+from tpu_olap.kernels.filtereval import UnsupportedFilter
+from tpu_olap.kernels.groupby import UnsupportedAggregation
+from tpu_olap.kernels.timebucket import UnsupportedGranularity
+from tpu_olap.planner import DruidPlanner
+from tpu_olap.planner.fallback import FallbackError, execute_fallback
+from tpu_olap.segments.ingest import (DEFAULT_BLOCK_ROWS, ingest_arrow,
+                                      ingest_pandas, ingest_parquet)
+
+_UNSUPPORTED = (UnsupportedAggregation, UnsupportedFilter,
+                UnsupportedGranularity, UnsupportedDimension)
+
+
+class Engine:
+    def __init__(self, config: EngineConfig | None = None):
+        self.config = config or EngineConfig()
+        self.catalog = Catalog()
+        self.runner = QueryRunner(self.config)
+        self.planner = DruidPlanner(self.catalog, self.config)
+        self.last_plan = None
+
+    # ------------------------------------------------------- registration
+
+    def register_table(self, name: str, data, time_column: str | None = None,
+                       star_schema=None, accelerate: bool = True,
+                       block_rows: int = DEFAULT_BLOCK_ROWS,
+                       column_map: dict | None = None, **options):
+        """Register a datasource. `data`: pandas DataFrame, pyarrow Table,
+        or parquet path. accelerate=False registers a plain (dimension)
+        table served only by the fallback path — the reference's
+        non-druid-backed relation."""
+        if isinstance(data, str):
+            import pyarrow.parquet as pq
+            frame = pq.read_table(data).to_pandas()
+        elif isinstance(data, pd.DataFrame):
+            frame = data.copy()
+        else:  # pyarrow table
+            frame = data.to_pandas()
+        if column_map:
+            frame = frame.rename(columns=dict(column_map))
+            if time_column in (column_map or {}):
+                time_column = column_map[time_column]
+        segments = None
+        if accelerate:
+            segments = ingest_pandas(name, frame, time_column, block_rows)
+        star = star_schema
+        if isinstance(star, dict):
+            star = StarSchema.from_json(star)
+        entry = TableEntry(name=name, segments=segments, frame=frame,
+                           time_column=time_column, star=star,
+                           options=dict(options))
+        self.catalog.register(entry)
+        return entry
+
+    # --------------------------------------------------------------- SQL
+
+    def sql(self, query: str) -> pd.DataFrame:
+        """Plan, execute (device or fallback), and return a DataFrame."""
+        plan = self.planner.plan(query)
+        self.last_plan = plan
+        if plan.rewritten:
+            try:
+                res = self.runner.execute(plan.query,
+                                          plan.entry.segments)
+                return self._frame_from(plan, res)
+            except _UNSUPPORTED as e:
+                plan.query = None
+                plan.fallback_reason = f"lowering failed: {e}"
+        return execute_fallback(plan.stmt, self.catalog, self.config)
+
+    def _frame_from(self, plan, res: QueryResult) -> pd.DataFrame:
+        cols = {}
+        for o in plan.outputs:
+            vals = [r.get(o.source) for r in res.rows]
+            if o.cast == "int":
+                vals = [int(v) if v is not None else None for v in vals]
+            elif o.cast == "datetime":
+                # naive UTC timestamps, matching pandas semantics
+                vals = pd.to_datetime(vals, utc=True).tz_localize(None)
+            cols[o.name] = vals
+        return pd.DataFrame(cols,
+                            columns=[o.name for o in plan.outputs])
+
+    def explain(self, query: str) -> dict:
+        """EXPLAIN DRUID REWRITE analog: the chosen QuerySpec (or the
+        fallback reason) without executing (SURVEY.md §4.5)."""
+        return self.planner.plan(query).explain()
+
+    # -------------------------------------------------------- passthrough
+
+    def execute_ir(self, query) -> QueryResult:
+        """Raw query-IR passthrough (`ON DRUID DATASOURCE ds EXECUTE QUERY
+        '<json>'`): accepts a QuerySpec or Druid-shaped JSON dict."""
+        if isinstance(query, dict):
+            query = query_from_json(query)
+        entry = self.catalog.get(query.data_source)
+        if not entry.is_accelerated:
+            raise ValueError(
+                f"table {query.data_source!r} is not accelerated")
+        return self.runner.execute(query, entry.segments)
+
+    # -------------------------------------------------------------- admin
+
+    def clear_cache(self, table: str | None = None):
+        """CLEAR DRUID CACHE analog: drop device-resident columns and
+        compiled programs (catalog entries stay registered)."""
+        self.runner.clear_cache(table)
+
+    @property
+    def history(self):
+        """Per-query observability records (SURVEY.md §6 tracing)."""
+        return self.runner.history

@@ -1,0 +1,9 @@
+"""Catalog — the analog of the reference's L4 relation/metadata layer
+(SURVEY.md §3.4): table registration with per-table options and column
+mapping (DefaultSource's OPTIONS map), star-schema declarations with
+functional dependencies (StarSchemaInfo), and a process-wide metadata cache
+with explicit invalidation (DruidMetadataCache + CLEAR DRUID CACHE).
+"""
+
+from tpu_olap.catalog.star import StarSchema, StarDimension, FunctionalDependency  # noqa: F401
+from tpu_olap.catalog.catalog import Catalog, TableEntry  # noqa: F401

@@ -1,0 +1,54 @@
+"""Table registry + metadata cache.
+
+The analog of the reference's DefaultSource.createRelation +
+DruidMetadataCache (SURVEY.md §4.1): a registered table pairs the segment
+store (the "Druid index") with its source DataFrame (the fallback path) and
+per-table options, exactly the dual the reference keeps (DruidRelationInfo
+carries the sourceDataframe ref). clear() is `CLEAR DRUID CACHE`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tpu_olap.catalog.star import StarSchema
+from tpu_olap.segments.segment import TableSegments
+
+
+@dataclass
+class TableEntry:
+    name: str
+    segments: TableSegments | None      # None: plain (dimension) table
+    frame: object                       # pandas DataFrame source of truth
+    time_column: str | None = None
+    star: StarSchema | None = None
+    options: dict = field(default_factory=dict)
+
+    @property
+    def is_accelerated(self) -> bool:
+        return self.segments is not None
+
+
+class Catalog:
+    def __init__(self):
+        self._tables: dict[str, TableEntry] = {}
+
+    def register(self, entry: TableEntry):
+        self._tables[entry.name] = entry
+
+    def get(self, name: str) -> TableEntry:
+        if name not in self._tables:
+            raise KeyError(f"unknown table {name!r}")
+        return self._tables[name]
+
+    def maybe(self, name: str) -> TableEntry | None:
+        return self._tables.get(name)
+
+    def names(self):
+        return sorted(self._tables)
+
+    def drop(self, name: str):
+        self._tables.pop(name, None)
+
+    def clear(self):
+        self._tables.clear()

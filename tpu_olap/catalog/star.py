@@ -1,0 +1,75 @@
+"""Star-schema declaration: fact table + dimension tables + FK edges.
+
+Mirrors the reference's StarSchemaInfo/StarRelationInfo/FunctionalDependency
+(SURVEY.md §3.4): the declaration that lets JoinTransform collapse
+fact ⋈ dim joins onto the single denormalized datasource (SURVEY.md §4.3).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class FunctionalDependency:
+    """determinant -> dependent within the denormalized fact table."""
+
+    determinant: str
+    dependent: str
+
+
+@dataclass
+class StarDimension:
+    table: str               # dimension table name (as used in SQL)
+    fact_key: str            # FK column on the fact table
+    dim_key: str             # PK column on the dimension table
+    column_map: dict = field(default_factory=dict)
+    # dim column -> denormalized fact column; identity by default for dim
+    # columns that exist on the fact table under the same name
+
+    def fact_column(self, dim_col: str) -> str | None:
+        return self.column_map.get(dim_col, dim_col)
+
+
+@dataclass
+class StarSchema:
+    fact: str
+    dimensions: tuple = ()
+    functional_dependencies: tuple = ()
+
+    def dim(self, table: str) -> StarDimension | None:
+        for d in self.dimensions:
+            if d.table == table:
+                return d
+        return None
+
+    def matches_join(self, dim_table: str, left: str, right: str) -> bool:
+        """Does `left == right` (column names) match the declared FK edge
+        for dim_table, in either order?"""
+        d = self.dim(dim_table)
+        if d is None:
+            return False
+        return {left, right} == {d.fact_key, d.dim_key}
+
+    @staticmethod
+    def from_json(j: dict) -> "StarSchema":
+        dims = tuple(
+            StarDimension(d["table"], d["factKey"], d["dimKey"],
+                          dict(d.get("columnMap", {})))
+            for d in j.get("dimensions", []))
+        fds = tuple(
+            FunctionalDependency(f["determinant"], f["dependent"])
+            for f in j.get("functionalDependencies", []))
+        return StarSchema(j["fact"], dims, fds)
+
+    def to_json(self) -> dict:
+        return {
+            "fact": self.fact,
+            "dimensions": [
+                {"table": d.table, "factKey": d.fact_key,
+                 "dimKey": d.dim_key, "columnMap": dict(d.column_map)}
+                for d in self.dimensions],
+            "functionalDependencies": [
+                {"determinant": f.determinant, "dependent": f.dependent}
+                for f in self.functional_dependencies],
+        }

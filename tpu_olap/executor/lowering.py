@@ -54,15 +54,25 @@ class PhysicalPlan:
     def fingerprint(self) -> tuple:
         import json
         t = _template(self.query.to_json())
-        return (self.table.name, json.dumps(t, sort_keys=True), self.statics)
+        return (self.table.name, json.dumps(t, sort_keys=True), self.statics,
+                self.pool.signature() if self.pool is not None else ())
 
 
 _LITERAL_KEYS = {"value", "values", "lower", "upper", "pattern", "intervals"}
 
 
 def _template(j):
-    """Strip literal values from a query-JSON tree, keep structure."""
+    """Strip literal values from a query-JSON tree, keep structure.
+
+    Expression subtrees (virtual columns, expression filters) are kept
+    VERBATIM including their literals: those literals are traced into the
+    jitted program as XLA constants (they never ride the ConstPool), so
+    stripping them would alias distinct programs in the compile cache —
+    `sum(x*2)` vs `sum(x*3)` must not share a fingerprint.
+    """
     if isinstance(j, dict):
+        if j.get("type") == "expression":
+            return j
         return {k: ("?" if k in _LITERAL_KEYS else _template(v))
                 for k, v in j.items()}
     if isinstance(j, list):

@@ -222,7 +222,9 @@ class QueryRunner:
         if ls is not None and ls.columns:
             keys = []
             for c in ls.columns[::-1]:
-                if c.dimension in dim_vals:
+                if c.dimension == "timestamp":
+                    k = np.asarray(buckets, np.float64)
+                elif c.dimension in dim_vals:
                     v = dim_vals[c.dimension]
                     k = np.asarray([("" if x is None else str(x)) for x in v])
                     if c.dimension_order == "numeric":

@@ -25,10 +25,19 @@ from tpu_olap.segments.segment import ColumnType, TIME_COLUMN
 
 
 class ConstPool:
-    """Named host constants shipped to the device as a dict pytree."""
+    """Named host constants shipped to the device as a dict pytree.
+
+    `tags` record literal-dependent *structural* choices made while
+    compiling closures (e.g. "selector is-null", "IN list contains null",
+    "unparseable literal -> match-nothing"). The compile cache must key on
+    tags + const layout: two queries with the same stripped template but
+    different closure structure would otherwise share a jitted program and
+    silently return wrong results.
+    """
 
     def __init__(self):
         self.consts: dict[str, np.ndarray] = {}
+        self.tags: list[str] = []
         self._n = 0
 
     def add(self, value, dtype=None) -> str:
@@ -36,6 +45,15 @@ class ConstPool:
         self._n += 1
         self.consts[name] = np.asarray(value, dtype=dtype)
         return name
+
+    def tag(self, s: str) -> None:
+        self.tags.append(s)
+
+    def signature(self) -> tuple:
+        """Structure-identifying key fragment: tags + const layout."""
+        layout = tuple((k, v.shape, str(v.dtype))
+                       for k, v in self.consts.items())
+        return (tuple(self.tags), layout)
 
 
 class UnsupportedFilter(Exception):
@@ -128,9 +146,11 @@ def compile_filter(spec, table, pool: ConstPool, virtual_exprs=None):
             return lambda env, c: env["cols"][col] == c[cid]
         # numeric
         if s.value is None:
+            pool.tag(f"sel-null:{col}")
             return lambda env, c: _null_mask(env, col)
         val = _parse_num(s.value, typ)
         if val is None:
+            pool.tag(f"sel-never:{col}")
             return _never(col)  # Druid: unparseable literal matches nothing
         cval = pool.add(val)
         return lambda env, c: ((env["cols"][col] == c[cval])
@@ -192,6 +212,8 @@ def compile_filter(spec, table, pool: ConstPool, virtual_exprs=None):
             parsed, dtype=np.float64 if any_float or typ is ColumnType.DOUBLE
             else np.int64))
         has_null = any(v is None for v in s.values)
+        if has_null:
+            pool.tag(f"in-null:{col}")
 
         def fn(env, c):
             x = env["cols"][col]

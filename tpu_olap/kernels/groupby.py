@@ -118,15 +118,20 @@ def compile_aggregations(aggs, table, pool, virtual_exprs=None,
 
 def _hash_tables(fields, table, pool, field_type):
     """Per-field value-hash const tables for string fields (None slots for
-    numeric fields). table[0] (null) is 0 — nulls are masked out anyway."""
-    import zlib
+    numeric fields). table[0] (null) is 0 — nulls are masked out anyway.
+    The table depends only on the dictionary, so it's memoized there (it's
+    an O(cardinality) host loop that must not run per query)."""
     out = []
     for f in fields:
         if field_type(f) is ColumnType.STRING:
             d = table.dictionaries[f]
-            t = np.zeros(d.size + 1, np.int32)
-            for i, v in enumerate(d.values):
-                t[i + 1] = np.int32(zlib.crc32(v.encode()) & 0x7FFFFFFF)
+            t = getattr(d, "_value_hash_table", None)
+            if t is None:
+                import zlib
+                t = np.zeros(d.size + 1, np.int32)
+                for i, v in enumerate(d.values):
+                    t[i + 1] = np.int32(zlib.crc32(v.encode()) & 0x7FFFFFFF)
+                d._value_hash_table = t
             out.append(pool.add(t))
         else:
             out.append(None)

@@ -1,0 +1,11 @@
+"""Planner — the analog of the reference's L6 rewrite layer (SURVEY.md
+§3.2): SQL text parses to a logical SELECT tree; rewrite rules in the
+reference's order (join collapse → project/filter pushdown + interval
+extraction → aggregate translation → limit/topN selection) compile it into
+a QuerySpec via the QueryBuilder accumulator; anything non-rewritable runs
+on the pandas fallback interpreter instead of erroring (SURVEY.md §2
+property 2: "fallback is structural").
+"""
+
+from tpu_olap.planner.sqlparse import parse_sql  # noqa: F401
+from tpu_olap.planner.plan import DruidPlanner, PlanResult, RewriteError  # noqa: F401

@@ -1,0 +1,47 @@
+"""Shared expression helpers — the ExprUtil analog (SURVEY.md §3.2):
+normalization/inspection used by both the rewriter and the fallback
+interpreter so the two paths can't drift.
+"""
+
+from __future__ import annotations
+
+import json
+
+from tpu_olap.ir.expr import BinOp, Col, FuncCall, Lit
+from tpu_olap.planner.sqlparse import AGG_FUNCS
+
+
+def split_and(e):
+    if e is None:
+        return []
+    if isinstance(e, BinOp) and e.op == "&&":
+        return split_and(e.left) + split_and(e.right)
+    return [e]
+
+
+def contains_agg(e) -> bool:
+    if isinstance(e, FuncCall):
+        if e.name in AGG_FUNCS:
+            return True
+        return any(contains_agg(a) for a in e.args)
+    if isinstance(e, BinOp):
+        return contains_agg(e.left) or contains_agg(e.right)
+    return False
+
+
+def expr_key(e) -> str:
+    """Structural identity for dedup/alias maps."""
+    return json.dumps(e.to_json(), sort_keys=True) \
+        if hasattr(e, "to_json") else repr(e)
+
+
+def render(e) -> str:
+    if isinstance(e, Col):
+        return e.name.split(".")[-1]
+    if isinstance(e, Lit):
+        return repr(e.value)
+    if isinstance(e, BinOp):
+        return f"({render(e.left)} {e.op} {render(e.right)})"
+    if isinstance(e, FuncCall):
+        return f"{e.name}({', '.join(render(a) for a in e.args)})"
+    return repr(e)

@@ -1,0 +1,328 @@
+"""Pandas fallback interpreter — the analog of the reference's
+source-DataFrame scan path (SURVEY.md §4.4: rewrite failure ⇒ correct-but-
+slow execution, never an error; BASELINE.json:7 keeps a CPU-fallback
+config). Implements the same SELECT subset as the parser with the same
+null semantics as the device kernels (comparisons with NULL are False,
+nulls form their own group, COUNT(col) counts non-nulls), so the parity
+harness can compare the two paths row for row.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+import pandas as pd
+
+from tpu_olap.ir.expr import BinOp, Col, FuncCall, Lit
+from tpu_olap.planner.exprutil import (contains_agg as _contains_agg,
+                                       expr_key as _k, render as _auto_name,
+                                       split_and as _split_and)
+from tpu_olap.planner.sqlparse import AGG_FUNCS, SelectStmt
+from tpu_olap.segments.dictionary import _like_to_regex
+
+_TIME_FUNCS = {"year", "month", "day", "dayofmonth", "quarter"}
+
+
+class FallbackError(Exception):
+    pass
+
+
+def execute_fallback(stmt: SelectStmt, catalog, config) -> pd.DataFrame:
+    entry = catalog.get(stmt.table)
+    df = entry.frame.copy()
+    time_col = entry.time_column
+    if time_col is not None and time_col in df.columns:
+        # match the accelerated path's deterministic time-sorted row order
+        # (segments are time-sorted, so unordered LIMIT picks the same rows)
+        df = df.sort_values(time_col, kind="stable")
+
+    # joins (inner equi-joins; conditions from ON or WHERE)
+    where_conjs = _split_and(stmt.where)
+    for j in stmt.joins:
+        other = catalog.get(j.table).frame
+        conds = _split_and(j.on) if j.on is not None else where_conjs
+        pair = None
+        for c in conds:
+            p = _equi_pair(c, df.columns, other.columns)
+            if p:
+                pair = (c, p)
+                break
+        if pair is None:
+            raise FallbackError(f"no join condition for {j.table!r}")
+        cond, (lcol, rcol) = pair
+        if j.on is None:
+            where_conjs.remove(cond)
+        how = "left" if j.kind == "left" else "inner"
+        df = df.merge(other, left_on=lcol, right_on=rcol, how=how,
+                      suffixes=("", f"__{j.table}"))
+        if j.on is not None:
+            for extra in [c for c in _split_and(j.on) if c is not cond]:
+                df = df[_eval_bool(extra, df, time_col)]
+
+    for c in where_conjs:
+        df = df[_eval_bool(c, df, time_col)]
+
+    out_names = []
+    exprs = []
+    for e, alias in stmt.projections:
+        if isinstance(e, Col) and e.name == "*":
+            for c in df.columns:
+                out_names.append(c)
+                exprs.append(Col(c))
+            continue
+        out_names.append(alias or _auto_name(e))
+        exprs.append(e)
+
+    has_agg = any(_contains_agg(e) for e in exprs)
+    group_exprs = list(stmt.group_by)
+    if stmt.distinct and not has_agg and not group_exprs:
+        group_exprs = list(exprs)
+
+    if group_exprs or has_agg:
+        out = _aggregate(df, exprs, out_names, group_exprs, stmt, time_col)
+    else:
+        out = pd.DataFrame(
+            {n: _eval(e, df, time_col) for n, e in zip(out_names, exprs)})
+        out = out.reset_index(drop=True)
+
+    if stmt.order_by and not (group_exprs or has_agg):
+        keys, ascending = [], []
+        for item in stmt.order_by:
+            name = _auto_name(item.expr)
+            col = name if name in out.columns else None
+            if col is None:
+                out["__sort"] = _eval(item.expr, df, time_col).to_numpy()
+                col = "__sort"
+            keys.append(col)
+            ascending.append(not item.descending)
+        out = out.sort_values(keys, ascending=ascending, kind="stable")
+        out = out.drop(columns=[c for c in ("__sort",) if c in out.columns])
+    lo = stmt.offset
+    hi = None if stmt.limit is None else lo + stmt.limit
+    return out.iloc[lo:hi].reset_index(drop=True)
+
+
+# ---------------------------------------------------------------------------
+
+
+def _aggregate(df, exprs, out_names, group_exprs, stmt, time_col):
+    gkeys = {}
+    gname_of = {}
+    for i, g in enumerate(group_exprs):
+        name = f"__g{i}"
+        gkeys[name] = _eval(g, df, time_col)
+        gname_of[_k(g)] = name
+    kdf = pd.DataFrame(gkeys) if gkeys else None
+
+    def agg_series(e, sub):
+        if isinstance(e, FuncCall) and e.name in AGG_FUNCS:
+            if e.name == "count" and not e.args:
+                return len(sub)
+            if e.name == "count":
+                return _eval(e.args[0], sub, time_col).notna().sum()
+            if e.name in ("count_distinct", "approx_count_distinct",
+                          "theta_sketch"):
+                vals = [_eval(a, sub, time_col) for a in e.args]
+                if len(vals) == 1:
+                    return vals[0].dropna().nunique()
+                tup = pd.concat(vals, axis=1).dropna()
+                return len(tup.drop_duplicates())
+            v = _eval(e.args[0], sub, time_col)
+            if e.name == "sum":
+                return v.sum()
+            if e.name == "min":
+                return v.min()
+            if e.name == "max":
+                return v.max()
+            if e.name == "avg":
+                return v.sum() / len(sub) if len(sub) else np.nan
+            raise FallbackError(f"unknown aggregate {e.name!r}")
+        if isinstance(e, BinOp):
+            l_val = agg_series(e.left, sub)
+            r_val = agg_series(e.right, sub)
+            if e.op == "/":
+                # match the device path's ArithmeticPostAgg rule: x/0 -> 0
+                return float(l_val) / r_val if r_val else 0.0
+            return _APPLY[e.op](l_val, r_val)
+        if isinstance(e, Lit):
+            return e.value
+        raise FallbackError(f"non-aggregate projection {e!r} with GROUP BY")
+
+    rows = []
+    if kdf is None:
+        rec = {}
+        for n, e in zip(out_names, exprs):
+            rec[n] = agg_series(e, df)
+        having = stmt.having
+        if having is not None and not _having_ok(having, df, rec, time_col,
+                                                 agg_series):
+            return pd.DataFrame(columns=out_names)
+        rows.append(rec)
+        return pd.DataFrame(rows, columns=out_names)
+
+    fill = "\0null"
+    filled = kdf.copy()
+    for c in filled.columns:
+        if filled[c].dtype == object or str(filled[c].dtype).startswith(
+                ("string", "category")):
+            filled[c] = filled[c].fillna(fill)
+    # pre-resolve ORDER BY items to either an output column or an
+    # extra computed key evaluated per group
+    order_cols, order_exprs, ascending = [], {}, []
+    for i, item in enumerate(stmt.order_by):
+        name = _auto_name(item.expr)
+        if name in out_names:
+            order_cols.append(name)
+        else:
+            col = f"__s{i}"
+            order_cols.append(col)
+            order_exprs[col] = item.expr
+        ascending.append(not item.descending)
+
+    grouped = df.groupby([filled[c] for c in filled.columns], sort=True,
+                         dropna=False)
+    for key, sub in grouped:
+        if not isinstance(key, tuple):
+            key = (key,)
+        rec = {}
+        for n, e in zip(out_names, exprs):
+            gk = _k(e)
+            if gk in gname_of:
+                pos = list(kdf.columns).index(gname_of[gk])
+                v = key[pos]
+                rec[n] = None if (isinstance(v, str) and v == fill) else v
+            else:
+                rec[n] = agg_series(e, sub)
+        if stmt.having is not None and not _having_ok(
+                stmt.having, sub, rec, time_col, agg_series):
+            continue
+        for col, e in order_exprs.items():
+            rec[col] = agg_series(e, sub) if _contains_agg(e) else \
+                _eval(e, sub, time_col).iloc[0]
+        rows.append(rec)
+    out = pd.DataFrame(rows, columns=out_names + list(order_exprs))
+
+    if order_cols:
+        out = out.sort_values(order_cols, ascending=ascending,
+                              kind="stable")
+    return out[out_names].reset_index(drop=True)
+
+
+def _having_ok(having, sub, rec, time_col, agg_series) -> bool:
+    e = having
+
+    def ev(x):
+        if isinstance(x, Lit):
+            return x.value
+        if _contains_agg(x):
+            return agg_series(x, sub)
+        if isinstance(x, Col):
+            return rec.get(x.name)
+        if isinstance(x, BinOp):
+            return _APPLY[x.op](ev(x.left), ev(x.right))
+        if isinstance(x, FuncCall) and x.name == "not":
+            return not ev(x.args[0])
+        raise FallbackError(f"cannot evaluate HAVING {x!r}")
+    return bool(ev(e))
+
+
+# ---------------------------------------------------------------------------
+
+_APPLY = {
+    "+": lambda a, b: a + b, "-": lambda a, b: a - b,
+    "*": lambda a, b: a * b, "/": lambda a, b: a / b,
+    "%": lambda a, b: a % b,
+    "==": lambda a, b: a == b, "!=": lambda a, b: a != b,
+    "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b, ">=": lambda a, b: a >= b,
+    "&&": lambda a, b: a & b, "||": lambda a, b: a | b,
+}
+
+
+def _ts(series, time_col):
+    if pd.api.types.is_datetime64_any_dtype(series):
+        return series
+    return pd.to_datetime(series, unit="ms")
+
+
+def _eval(e, df, time_col):
+    """Expression -> Series aligned with df (scalar for Lit)."""
+    if isinstance(e, Lit):
+        return pd.Series([e.value] * len(df), index=df.index) \
+            if len(df) else pd.Series([], dtype=object)
+    if isinstance(e, Col):
+        name = e.name.split(".")[-1]
+        if name not in df.columns:
+            raise FallbackError(f"unknown column {name!r}")
+        return df[name]
+    if isinstance(e, BinOp):
+        left = _eval(e.left, df, time_col)
+        right = _eval(e.right, df, time_col)
+        if e.op == "/":
+            left = left.astype(float) if hasattr(left, "astype") else left
+        out = _APPLY[e.op](left, right)
+        if e.op in ("==", "!=", "<", "<=", ">", ">=", "&&", "||") and \
+                hasattr(out, "fillna"):
+            out = out.fillna(False).astype(bool)
+        return out
+    if isinstance(e, FuncCall):
+        fn = e.name
+        if fn in _TIME_FUNCS:
+            t = _ts(_eval(e.args[0], df, time_col), time_col)
+            return getattr(t.dt, {"day": "day", "dayofmonth": "day"}
+                           .get(fn, fn))
+        if fn == "date_trunc":
+            unit = str(e.args[0].value).lower()
+            t = _ts(_eval(e.args[1], df, time_col), time_col)
+            freq = {"second": "s", "minute": "min", "hour": "h", "day": "D",
+                    "week": "W", "month": "MS", "quarter": "QS",
+                    "year": "YS"}[unit]
+            if unit in ("month", "quarter", "year", "week"):
+                return t.dt.to_period(
+                    {"month": "M", "quarter": "Q", "year": "Y",
+                     "week": "W-SUN"}[unit]).dt.start_time
+            return t.dt.floor(freq)
+        if fn == "not":
+            v = _eval(e.args[0], df, time_col)
+            return (~v.astype(bool)) if hasattr(v, "astype") else (not v)
+        if fn == "is_null":
+            return _eval(e.args[0], df, time_col).isna()
+        if fn == "in_list":
+            v = _eval(e.args[0], df, time_col)
+            vals = [a.value for a in e.args[1:]]
+            has_null = any(x is None for x in vals)
+            m = v.isin([x for x in vals if x is not None])
+            if has_null:
+                m = m | v.isna()
+            return m
+        if fn == "like":
+            v = _eval(e.args[0], df, time_col)
+            rx = re.compile(_like_to_regex(e.args[1].value))
+            return v.map(lambda x: x is not None and not pd.isna(x)
+                         and rx.fullmatch(str(x)) is not None)
+        if fn == "abs":
+            return _eval(e.args[0], df, time_col).abs()
+        raise FallbackError(f"unknown function {fn!r}")
+    raise FallbackError(f"cannot evaluate {e!r}")
+
+
+def _eval_bool(e, df, time_col):
+    v = _eval(e, df, time_col)
+    if hasattr(v, "fillna"):
+        return v.fillna(False).astype(bool)
+    return bool(v)
+
+
+def _equi_pair(c, left_cols, right_cols):
+    if isinstance(c, BinOp) and c.op == "==" and \
+            isinstance(c.left, Col) and isinstance(c.right, Col):
+        a = c.left.name.split(".")[-1]
+        b = c.right.name.split(".")[-1]
+        if a in left_cols and b in right_cols:
+            return (a, b)
+        if b in left_cols and a in right_cols:
+            return (b, a)
+    return None
+
+

@@ -1,0 +1,729 @@
+"""DruidPlanner analog: SELECT statement -> QuerySpec (or fallback).
+
+Implements the reference's rewrite pipeline in its order (SURVEY.md §4.2):
+join collapse against the declared star schema, projection/filter pushdown
+with interval extraction (IntervalConditionExtractor), aggregate
+translation (AVG -> sum/count post-agg, COUNT DISTINCT -> HLL cardinality,
+sum over expressions -> virtual columns), and limit/topN selection
+(allowTopN). Any non-expressible construct raises RewriteError, which the
+engine turns into transparent pandas-fallback execution — never an error
+(SURVEY.md §2 property 2).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+
+from tpu_olap.catalog.catalog import TableEntry
+from tpu_olap.ir import filters as F
+from tpu_olap.ir.aggregations import (CardinalityAggregation,
+                                      CountAggregation, MaxAggregation,
+                                      MinAggregation, SumAggregation,
+                                      ThetaSketchAggregation)
+from tpu_olap.ir.dimensions import (DefaultDimensionSpec,
+                                    ExtractionDimensionSpec,
+                                    TimeFormatExtractionFn, VirtualColumn)
+from tpu_olap.ir.expr import BinOp, Col, Expr, FuncCall, Lit
+from tpu_olap.ir.granularity import AllGranularity, PeriodGranularity
+from tpu_olap.ir.interval import ETERNITY, Interval
+from tpu_olap.ir.limit import LimitSpec, OrderByColumnSpec
+from tpu_olap.ir.having import (AndHaving, EqualToHaving, GreaterThanHaving,
+                                LessThanHaving, NotHaving, OrHaving)
+from tpu_olap.ir.postaggs import (ArithmeticPostAgg, ConstantPostAgg,
+                                  FieldAccessPostAgg)
+from tpu_olap.ir.query import (GroupByQuerySpec, ScanQuerySpec,
+                               TimeseriesQuerySpec, TopNQuerySpec)
+from tpu_olap.planner.exprutil import (contains_agg as _contains_agg,
+                                       expr_key as _key, render as _render,
+                                       split_and as _split_and)
+from tpu_olap.planner.sqlparse import (AGG_FUNCS, OrderItem, SelectStmt,
+                                       parse_sql)
+from tpu_olap.segments.segment import ColumnType, TIME_COLUMN
+from tpu_olap.utils import timeutil
+
+
+class RewriteError(Exception):
+    """Query shape not expressible on the device path -> fallback."""
+
+
+_CMP = ("==", "!=", "<", "<=", ">", ">=")
+_TIME_FUNCS = {"year": ("YYYY", "int"), "month": ("MM", "int"),
+               "day": ("dd", "int"), "dayofmonth": ("dd", "int")}
+_TRUNC_UNITS = {"second": "PT1S", "minute": "PT1M", "hour": "PT1H",
+                "day": "P1D", "week": "P1W", "month": "P1M",
+                "quarter": "P3M", "year": "P1Y"}
+
+
+@dataclass
+class OutputColumn:
+    name: str           # SQL output name
+    source: str         # key in executor result rows
+    cast: str | None = None  # None | "int" | "datetime"
+
+
+@dataclass
+class PlanResult:
+    stmt: SelectStmt
+    entry: TableEntry
+    query: object = None            # QuerySpec when rewritten
+    outputs: list = field(default_factory=list)
+    fallback_reason: str | None = None
+    sql: str | None = None
+
+    @property
+    def rewritten(self) -> bool:
+        return self.query is not None
+
+    def explain(self) -> dict:
+        """The `EXPLAIN DRUID REWRITE` payload (SURVEY.md §4.5)."""
+        if self.rewritten:
+            return {"rewritten": True, "datasource": self.entry.name,
+                    "query": self.query.to_json(),
+                    "outputs": [o.name for o in self.outputs]}
+        return {"rewritten": False, "reason": self.fallback_reason,
+                "table": self.entry.name}
+
+
+class DruidPlanner:
+    """Registers no global state — one instance per Engine (the reference's
+    DruidPlanner(sqlContext) kept per-session rule lists, SURVEY.md §3.2)."""
+
+    def __init__(self, catalog, config):
+        self.catalog = catalog
+        self.config = config
+
+    def plan(self, sql: str) -> PlanResult:
+        stmt = parse_sql(sql)
+        entry = self.catalog.get(stmt.table)
+        result = PlanResult(stmt=stmt, entry=entry, sql=sql)
+        try:
+            _Rewriter(self, stmt, entry, result).run()
+        except RewriteError as e:
+            result.query = None
+            result.fallback_reason = str(e)
+        return result
+
+
+class _Rewriter:
+    def __init__(self, planner: DruidPlanner, stmt, entry, result):
+        self.planner = planner
+        self.catalog = planner.catalog
+        self.config = planner.config
+        self.stmt = stmt
+        self.entry = entry
+        self.result = result
+        self.table = entry.segments
+        self.rename: dict[str, str] = {}
+        self.vcols: list[VirtualColumn] = []
+        self.aggs: list = []
+        self.postaggs: list = []
+        self._agg_by_key: dict = {}
+        self._names = (f"a{i}" for i in itertools.count())
+        self.alias_of: dict = {}  # structural expr key -> SQL alias
+
+    # ------------------------------------------------------------- pipeline
+
+    def run(self):
+        if not self.entry.is_accelerated:
+            raise RewriteError(f"table {self.entry.name!r} is not "
+                               "druid-backed (no segment index)")
+        stmt = self.stmt
+        conjuncts = _split_and(stmt.where)
+        conjuncts = self._collapse_joins(conjuncts)
+        conjuncts = [self._resolve(e) for e in conjuncts]
+        intervals, conjuncts = self._extract_intervals(conjuncts)
+        filter_spec = None
+        if conjuncts:
+            filter_spec = F.and_of(*[self._to_filter(e) for e in conjuncts])
+
+        group_exprs = [self._resolve(e) for e in stmt.group_by]
+        projections = [(self._resolve(e), a) for e, a in stmt.projections]
+        if stmt.distinct:
+            if self._has_agg(projections):
+                raise RewriteError("SELECT DISTINCT with aggregates")
+            if group_exprs:
+                raise RewriteError("SELECT DISTINCT with GROUP BY")
+            group_exprs = [e for e, _ in projections]
+
+        for e, a in projections:
+            if a is not None:
+                self.alias_of[_key(e)] = a
+
+        if not group_exprs and not self._has_agg(projections):
+            return self._build_scan(projections, filter_spec, intervals)
+        return self._build_agg(projections, group_exprs, filter_spec,
+                               intervals)
+
+    # ---------------------------------------------------------------- joins
+
+    def _collapse_joins(self, conjuncts):
+        """JoinTransform (SURVEY.md §4.3): every joined table must be a
+        declared star dimension whose FK edge appears as an equi-join
+        condition; dim columns then rename to fact columns."""
+        stmt = self.stmt
+        if not stmt.joins:
+            return conjuncts
+        star = self.entry.star
+        if star is None:
+            raise RewriteError("join query but no star schema declared")
+        conjuncts = list(conjuncts)
+        for j in stmt.joins:
+            if j.kind != "inner":
+                raise RewriteError(f"{j.kind} join not collapsible")
+            sd = star.dim(j.table)
+            if sd is None:
+                raise RewriteError(
+                    f"joined table {j.table!r} is not a declared star "
+                    "dimension")
+            cand = _split_and(j.on) if j.on is not None else conjuncts
+            found = None
+            for c in cand:
+                pair = _equi_join_cols(c)
+                if pair and star.matches_join(j.table, *pair):
+                    found = c
+                    break
+            if found is None:
+                raise RewriteError(
+                    f"no FK join condition for star dimension {j.table!r}")
+            if j.on is not None:
+                rest = [c for c in _split_and(j.on) if c is not found]
+                conjuncts.extend(rest)
+            else:
+                conjuncts.remove(found)
+            # rename dim columns -> denormalized fact columns
+            dim_entry = self.catalog.maybe(j.table)
+            dim_cols = (list(dim_entry.frame.columns)
+                        if dim_entry is not None else [])
+            for c in dim_cols:
+                fact_col = sd.fact_column(c)
+                if fact_col in self.table.schema or \
+                        fact_col == self.entry.time_column:
+                    self.rename[c] = fact_col
+                    self.rename[f"{j.table}.{c}"] = fact_col
+        return conjuncts
+
+    # ---------------------------------------------------- column resolution
+
+    def _resolve(self, e: Expr) -> Expr:
+        if e is None:
+            return None
+        if isinstance(e, Col):
+            name = e.name
+            if "." in name:
+                qual, base = name.split(".", 1)
+                if qual == self.entry.name:
+                    name = base
+                elif name in self.rename:
+                    name = self.rename[name]
+                else:
+                    name = base
+            name = self.rename.get(name, name)
+            if name == self.entry.time_column:
+                name = TIME_COLUMN
+            return Col(name)
+        if isinstance(e, BinOp):
+            return BinOp(e.op, self._resolve(e.left), self._resolve(e.right))
+        if isinstance(e, FuncCall):
+            return FuncCall(e.name, tuple(self._resolve(a) for a in e.args))
+        return e
+
+    def _check_col(self, name: str) -> str:
+        if name == "*":
+            raise RewriteError("* not valid here")
+        if name not in self.table.schema:
+            raise RewriteError(f"unknown column {name!r}")
+        return name
+
+    def _col_type(self, name: str):
+        return self.table.schema[self._check_col(name)]
+
+    # ----------------------------------------------------- interval extract
+
+    def _extract_intervals(self, conjuncts):
+        """IntervalConditionExtractor analog (SURVEY.md §3.2): conjuncts
+        over the time column become query intervals."""
+        iv = ETERNITY
+        rest = []
+        for c in conjuncts:
+            got = self._time_condition(c)
+            if got is None:
+                if _mentions_time_fn(c):
+                    raise RewriteError(
+                        f"time condition not extractable: {c!r}")
+                rest.append(c)
+            else:
+                x = iv.intersect(got)
+                iv = x if x is not None else Interval(0, 0)
+        intervals = () if iv == ETERNITY else (iv,)
+        return intervals, rest
+
+    def _time_condition(self, e) -> Interval | None:
+        if not isinstance(e, BinOp) or e.op not in _CMP:
+            return None
+        left, right = e.left, e.right
+        op = e.op
+        if isinstance(right, (Col, FuncCall)) and isinstance(left, Lit):
+            left, right = right, left
+            op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+        if not isinstance(right, Lit):
+            return None
+        # year(__time) CMP N
+        if isinstance(left, FuncCall) and left.name == "year" and \
+                len(left.args) == 1 and left.args[0] == Col(TIME_COLUMN) and \
+                isinstance(right.value, int):
+            y = right.value
+            lo = timeutil.date_to_millis(y)
+            hi = timeutil.date_to_millis(y + 1)
+            return {"==": Interval(lo, hi),
+                    "<": Interval(-(2**62), lo),
+                    "<=": Interval(-(2**62), hi),
+                    ">": Interval(hi, 2**62),
+                    ">=": Interval(lo, 2**62)}.get(op)
+        # __time CMP 'date literal' / epoch-millis number
+        if left == Col(TIME_COLUMN):
+            v = right.value
+            if isinstance(v, str):
+                try:
+                    ms = timeutil.parse_iso_datetime(v)
+                except ValueError:
+                    return None
+            elif isinstance(v, (int, float)):
+                ms = int(v)
+            else:
+                return None
+            return {"==": Interval(ms, ms + 1),
+                    "<": Interval(-(2**62), ms),
+                    "<=": Interval(-(2**62), ms + 1),
+                    ">": Interval(ms + 1, 2**62),
+                    ">=": Interval(ms, 2**62)}.get(op)
+        return None
+
+    # -------------------------------------------------------------- filters
+
+    def _to_filter(self, e) -> F.FilterSpec:
+        if isinstance(e, BinOp) and e.op == "&&":
+            return F.and_of(self._to_filter(e.left), self._to_filter(e.right))
+        if isinstance(e, BinOp) and e.op == "||":
+            return F.OrFilter((self._to_filter(e.left),
+                               self._to_filter(e.right)))
+        if isinstance(e, FuncCall) and e.name == "not":
+            return F.NotFilter(self._to_filter(e.args[0]))
+        if isinstance(e, FuncCall) and e.name == "is_null":
+            col = self._filter_col(e.args[0])
+            return F.SelectorFilter(col, None)
+        if isinstance(e, FuncCall) and e.name == "in_list":
+            col = self._filter_col(e.args[0])
+            vals = []
+            for a in e.args[1:]:
+                if not isinstance(a, Lit):
+                    raise RewriteError("non-literal IN list")
+                vals.append(a.value)
+            return F.InFilter(col, tuple(vals))
+        if isinstance(e, FuncCall) and e.name == "like":
+            col = self._filter_col(e.args[0])
+            pat = e.args[1]
+            if not isinstance(pat, Lit) or not isinstance(pat.value, str):
+                raise RewriteError("LIKE pattern must be a string literal")
+            if self._col_type(col) is not ColumnType.STRING:
+                raise RewriteError(f"LIKE over non-string column {col!r}")
+            return F.LikeFilter(col, pat.value)
+        if isinstance(e, BinOp) and e.op in _CMP:
+            left, right, op = e.left, e.right, e.op
+            if isinstance(left, Lit) and isinstance(right, Col):
+                left, right = right, left
+                op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+            if isinstance(left, Col) and isinstance(right, Lit):
+                col = self._check_col(left.name)
+                v = right.value
+                typ = self._col_type(col)
+                ordering = ("lexicographic"
+                            if typ is ColumnType.STRING
+                            and isinstance(v, str) else "numeric")
+                if op == "==":
+                    return F.SelectorFilter(col, v)
+                if op == "!=":
+                    return F.NotFilter(F.SelectorFilter(col, v))
+                if op in ("<", "<="):
+                    return F.BoundFilter(col, upper=v,
+                                         upper_strict=(op == "<"),
+                                         ordering=ordering)
+                return F.BoundFilter(col, lower=v,
+                                     lower_strict=(op == ">"),
+                                     ordering=ordering)
+            # general expression comparison
+            return self._expression_filter(e)
+        raise RewriteError(f"cannot translate predicate {e!r}")
+
+    def _filter_col(self, e) -> str:
+        if not isinstance(e, Col):
+            raise RewriteError(f"expected a column, got {e!r}")
+        return self._check_col(e.name)
+
+    def _expression_filter(self, e) -> F.FilterSpec:
+        for c in e.columns():
+            if self._col_type(c) is ColumnType.STRING:
+                raise RewriteError(
+                    f"expression predicate over string column {c!r}")
+        return F.ExpressionFilter(e)
+
+    # ----------------------------------------------------------- aggregates
+
+    def _has_agg(self, projections) -> bool:
+        return any(_contains_agg(e) for e, _ in projections)
+
+    def _name_for(self, e) -> str:
+        return self.alias_of.get(_key(e)) or next(self._names)
+
+    def _vcol_for(self, e: Expr) -> tuple[str, str]:
+        """Expression -> (virtual column name, value type)."""
+        for c in e.columns():
+            if self._col_type(c) is ColumnType.STRING:
+                raise RewriteError(f"aggregate over string column {c!r}")
+        vt = "long"
+        for c in e.columns():
+            if self.table.schema[c] is ColumnType.DOUBLE:
+                vt = "double"
+        if _has_division(e):
+            vt = "double"
+        for v in self.vcols:
+            if v.expression == e:
+                return v.name, v.output_type
+        name = f"v{len(self.vcols)}"
+        self.vcols.append(VirtualColumn(name, e, vt))
+        return name, vt
+
+    def _agg_field(self, e: Expr) -> tuple[str, str]:
+        """Aggregate input -> (field name, "long"|"double")."""
+        if isinstance(e, Col):
+            col = self._check_col(e.name)
+            typ = self._col_type(col)
+            if typ is ColumnType.STRING:
+                raise RewriteError(f"aggregate over string column {col!r}")
+            return col, ("double" if typ is ColumnType.DOUBLE else "long")
+        return self._vcol_for(e)
+
+    def _make_agg(self, e: FuncCall) -> str:
+        """Aggregate call -> IR aggregation (deduped); returns output name."""
+        k = _key(e)
+        if k in self._agg_by_key:
+            return self._agg_by_key[k]
+        name = self._name_for(e)
+        fn = e.name
+        if fn == "count" and not e.args:
+            self.aggs.append(CountAggregation(name))
+        elif fn in ("sum", "min", "max"):
+            if len(e.args) != 1:
+                raise RewriteError(f"{fn} takes one argument")
+            fieldn, vt = self._agg_field(e.args[0])
+            cls = {"sum": SumAggregation, "min": MinAggregation,
+                   "max": MaxAggregation}[fn]
+            self.aggs.append(cls(name, fieldn, vt))
+        elif fn == "count":  # count(col): non-null count
+            fieldn, _ = self._agg_field(e.args[0])
+            from tpu_olap.ir.aggregations import FilteredAggregation
+            self.aggs.append(FilteredAggregation(
+                F.NotFilter(F.SelectorFilter(fieldn, None)),
+                CountAggregation(name)))
+        elif fn in ("count_distinct", "approx_count_distinct"):
+            if fn == "count_distinct" and not self.config.allow_count_distinct:
+                raise RewriteError(
+                    "COUNT(DISTINCT) disabled (allow_count_distinct=False); "
+                    "exact distinct runs on the fallback path")
+            cols = []
+            for a in e.args:
+                if not isinstance(a, Col):
+                    raise RewriteError("COUNT(DISTINCT expr) not supported")
+                cols.append(self._check_col(a.name))
+            self.aggs.append(CardinalityAggregation(name, tuple(cols),
+                                                    by_row=len(cols) > 1))
+        elif fn == "theta_sketch":
+            col = self._filter_col(e.args[0])
+            self.aggs.append(ThetaSketchAggregation(name, col))
+        elif fn == "avg":
+            fieldn, vt = self._agg_field(e.args[0])
+            s = next(self._names)
+            c = next(self._names)
+            self.aggs.append(SumAggregation(s, fieldn, vt))
+            self.aggs.append(CountAggregation(c))
+            self.postaggs.append(ArithmeticPostAgg(
+                name, "/", (FieldAccessPostAgg(s), FieldAccessPostAgg(c))))
+        else:
+            raise RewriteError(f"unknown aggregate {fn!r}")
+        self._agg_by_key[k] = name
+        return name
+
+    def _agg_output(self, e: Expr) -> str:
+        """Projection expr (aggregate or arithmetic over aggregates) ->
+        output name, creating aggs/post-aggs as needed."""
+        if isinstance(e, FuncCall) and e.name in AGG_FUNCS:
+            return self._make_agg(e)
+        k = _key(e)
+        if k in self._agg_by_key:
+            return self._agg_by_key[k]
+        name = self._name_for(e)
+        self.postaggs.append(self._to_postagg(e, name))
+        self._agg_by_key[k] = name
+        return name
+
+    def _to_postagg(self, e: Expr, name: str = ""):
+        if isinstance(e, Lit):
+            return ConstantPostAgg(float(e.value), name)
+        if isinstance(e, FuncCall) and e.name in AGG_FUNCS:
+            return FieldAccessPostAgg(self._make_agg(e), name)
+        if isinstance(e, BinOp) and e.op in ("+", "-", "*", "/"):
+            return ArithmeticPostAgg(name, e.op,
+                                     (self._to_postagg(e.left),
+                                      self._to_postagg(e.right)))
+        raise RewriteError(f"cannot translate aggregate expression {e!r}")
+
+    # ------------------------------------------------------------- group by
+
+    def _classify_groups(self, group_exprs):
+        """Group exprs -> (dimension specs, granularity, time outputs)."""
+        dims = []
+        granularity = AllGranularity()
+        outputs = {}  # expr key -> OutputColumn
+        trunc_seen = False
+        for e in group_exprs:
+            alias = self.alias_of.get(_key(e))
+            if isinstance(e, Col):
+                col = self._check_col(e.name)
+                if col == TIME_COLUMN:
+                    raise RewriteError("GROUP BY raw __time not supported "
+                                       "(use date_trunc)")
+                name = alias or col
+                dims.append(DefaultDimensionSpec(col, name))
+                outputs[_key(e)] = OutputColumn(name, name)
+                continue
+            if isinstance(e, FuncCall) and e.name in _TIME_FUNCS and \
+                    len(e.args) == 1 and e.args[0] == Col(TIME_COLUMN):
+                fmt, cast = _TIME_FUNCS[e.name]
+                name = alias or e.name
+                dims.append(ExtractionDimensionSpec(
+                    TIME_COLUMN,
+                    TimeFormatExtractionFn(fmt, self.config.time_zone),
+                    name))
+                outputs[_key(e)] = OutputColumn(name, name, cast)
+                continue
+            if isinstance(e, FuncCall) and e.name == "date_trunc" and \
+                    len(e.args) == 2 and isinstance(e.args[0], Lit) and \
+                    e.args[1] == Col(TIME_COLUMN):
+                unit = str(e.args[0].value).lower()
+                if unit not in _TRUNC_UNITS:
+                    raise RewriteError(f"unknown date_trunc unit {unit!r}")
+                if trunc_seen:
+                    raise RewriteError("multiple date_trunc group columns")
+                trunc_seen = True
+                granularity = PeriodGranularity(_TRUNC_UNITS[unit],
+                                                self.config.time_zone)
+                name = alias or "date_trunc"
+                outputs[_key(e)] = OutputColumn(name, "timestamp",
+                                                "datetime")
+                continue
+            raise RewriteError(f"cannot group by {e!r}")
+        return dims, granularity, outputs
+
+    # ------------------------------------------------------------- builders
+
+    def _build_agg(self, projections, group_exprs, filter_spec, intervals):
+        dims, granularity, group_outputs = \
+            self._classify_groups(group_exprs)
+
+        outputs = []
+        for e, alias in projections:
+            k = _key(e)
+            if k in group_outputs:
+                oc = group_outputs[k]
+                outputs.append(OutputColumn(alias or oc.name, oc.source,
+                                            oc.cast))
+            elif _contains_agg(e):
+                name = self._agg_output(e)
+                outputs.append(OutputColumn(alias or _render(e), name))
+            else:
+                raise RewriteError(
+                    f"projection {_render(e)} is neither grouped nor "
+                    "aggregated")
+
+        having_spec = None
+        if self.stmt.having is not None:
+            having_spec = self._to_having(self._resolve(self.stmt.having))
+
+        limit_spec, topn = self._limit_transform(dims, granularity, outputs)
+
+        common = dict(
+            data_source=self.entry.name,
+            intervals=intervals,
+            filter=filter_spec,
+            virtual_columns=tuple(self.vcols),
+            # SQL GROUP BY emits only non-empty buckets; but a global
+            # aggregate (granularity=all, no dims) must emit its one row
+            # even when nothing matches
+            context=(("skipEmptyBuckets",
+                      not isinstance(granularity, AllGranularity)),),
+        )
+        if topn is not None and having_spec is None:
+            metric, threshold, inverted = topn
+            query = TopNQuerySpec(
+                dimension=dims[0], metric=metric, threshold=threshold,
+                inverted=inverted, granularity=granularity,
+                aggregations=tuple(self.aggs),
+                post_aggregations=tuple(self.postaggs), **common)
+        elif not dims and limit_spec is None:
+            query = TimeseriesQuerySpec(
+                granularity=granularity, aggregations=tuple(self.aggs),
+                post_aggregations=tuple(self.postaggs), **common)
+        else:
+            query = GroupByQuerySpec(
+                dimensions=tuple(dims), granularity=granularity,
+                aggregations=tuple(self.aggs),
+                post_aggregations=tuple(self.postaggs),
+                having=having_spec, limit_spec=limit_spec, **common)
+        self.result.query = query
+        self.result.outputs = outputs
+
+    def _to_having(self, e):
+        if isinstance(e, BinOp) and e.op == "&&":
+            return AndHaving((self._to_having(e.left),
+                              self._to_having(e.right)))
+        if isinstance(e, BinOp) and e.op == "||":
+            return OrHaving((self._to_having(e.left),
+                             self._to_having(e.right)))
+        if isinstance(e, FuncCall) and e.name == "not":
+            return NotHaving(self._to_having(e.args[0]))
+        if isinstance(e, BinOp) and e.op in _CMP:
+            left, right, op = e.left, e.right, e.op
+            if isinstance(left, Lit):
+                left, right = right, left
+                op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+            if not isinstance(right, Lit) or not _contains_agg(left):
+                raise RewriteError(f"HAVING predicate not on an aggregate: "
+                                   f"{_render(e)}")
+            name = self._agg_output(left)
+            v = float(right.value)
+            if op == ">":
+                return GreaterThanHaving(name, v)
+            if op == "<":
+                return LessThanHaving(name, v)
+            if op == "==":
+                return EqualToHaving(name, v)
+            if op == ">=":
+                return NotHaving(LessThanHaving(name, v))
+            if op == "<=":
+                return NotHaving(GreaterThanHaving(name, v))
+            if op == "!=":
+                return NotHaving(EqualToHaving(name, v))
+        raise RewriteError(f"cannot translate HAVING {_render(e)}")
+
+    def _limit_transform(self, dims, granularity, outputs):
+        """ORDER BY + LIMIT -> LimitSpec; TopN eligibility per the
+        reference's allowTopN rule (SURVEY.md §3.2 LimitTransform)."""
+        stmt = self.stmt
+        if not stmt.order_by and stmt.limit is None:
+            return None, None
+        by_source = {}
+        for o in outputs:
+            by_source.setdefault(o.name, o.source)
+            by_source.setdefault(o.source, o.source)
+        cols = []
+        for item in stmt.order_by:
+            e = self._resolve(item.expr)
+            key = _key(e)
+            if key in self._agg_by_key:
+                src = self._agg_by_key[key]
+            elif isinstance(e, Col) and e.name in by_source:
+                src = by_source[e.name]
+            elif _contains_agg(e):
+                src = self._agg_output(e)
+            else:
+                raise RewriteError(
+                    f"ORDER BY {_render(e)} is not an output column")
+            dim_names = {d.name for d in dims}
+            order = ("lexicographic" if src in dim_names else "numeric")
+            cols.append(OrderByColumnSpec(
+                src, "descending" if item.descending else "ascending",
+                order))
+        limit_spec = LimitSpec(stmt.limit, tuple(cols), stmt.offset)
+
+        topn = None
+        agg_names = {a.name for a in self.aggs} | \
+            {p.name for p in self.postaggs}
+        if (self.config.allow_topn and len(dims) == 1
+                and isinstance(granularity, AllGranularity)
+                and stmt.limit is not None and stmt.offset == 0
+                and stmt.limit <= self.config.topn_max_threshold
+                and len(cols) == 1 and cols[0].dimension in agg_names):
+            topn = (cols[0].dimension, stmt.limit,
+                    cols[0].direction == "ascending")
+        return limit_spec, topn
+
+    def _build_scan(self, projections, filter_spec, intervals):
+        cols = []
+        outputs = []
+        for e, alias in projections:
+            if isinstance(e, Col) and e.name == "*":
+                for c in self.table.schema:
+                    cols.append(c)
+                    outputs.append(OutputColumn(c, c))
+                continue
+            if not isinstance(e, Col):
+                raise RewriteError(
+                    "computed projections without GROUP BY are not pushed "
+                    "down")
+            c = self._check_col(e.name)
+            cols.append(c)
+            outputs.append(OutputColumn(alias or e.name, c))
+        order = "none"
+        if self.stmt.order_by:
+            if len(self.stmt.order_by) != 1:
+                raise RewriteError("scan ORDER BY must be the time column")
+            item = self.stmt.order_by[0]
+            e = self._resolve(item.expr)
+            if e != Col(TIME_COLUMN):
+                raise RewriteError("scan ORDER BY must be the time column")
+            order = "descending" if item.descending else "ascending"
+        query = ScanQuerySpec(
+            data_source=self.entry.name,
+            intervals=intervals,
+            filter=filter_spec,
+            virtual_columns=tuple(self.vcols),
+            columns=tuple(cols),
+            limit=self.stmt.limit,
+            offset=self.stmt.offset,
+            order=order,
+        )
+        self.result.query = query
+        self.result.outputs = outputs
+
+
+# ---------------------------------------------------------------------------
+
+
+def _equi_join_cols(e):
+    if isinstance(e, BinOp) and e.op == "==" and \
+            isinstance(e.left, Col) and isinstance(e.right, Col):
+        return (e.left.name.split(".")[-1], e.right.name.split(".")[-1])
+    return None
+
+
+def _mentions_time_fn(e) -> bool:
+    if isinstance(e, FuncCall):
+        if e.name in _TIME_FUNCS or e.name == "date_trunc":
+            if any(Col(TIME_COLUMN) == a for a in e.args):
+                return True
+        return any(_mentions_time_fn(a) for a in e.args)
+    if isinstance(e, BinOp):
+        return _mentions_time_fn(e.left) or _mentions_time_fn(e.right)
+    if isinstance(e, Col):
+        return False
+    return False
+
+
+def _has_division(e) -> bool:
+    if isinstance(e, BinOp):
+        return e.op == "/" or _has_division(e.left) or _has_division(e.right)
+    if isinstance(e, FuncCall):
+        return any(_has_division(a) for a in e.args)
+    return False
+
+

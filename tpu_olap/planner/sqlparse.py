@@ -1,0 +1,365 @@
+"""Hand-rolled SQL parser for the BI subset the reference accelerates.
+
+The analog of the reference's SparklineDataParser + Spark's own SQL parser
+(SURVEY.md §3.1) — scoped to the SELECT shape the rewrite rules understand:
+
+  SELECT expr [AS alias], ...
+  FROM t1 [, t2 ...] [[INNER|LEFT] JOIN t3 ON cond]*
+  [WHERE cond] [GROUP BY exprs] [HAVING cond]
+  [ORDER BY expr [ASC|DESC], ...] [LIMIT n [OFFSET m]]
+
+Scalar/boolean expressions reuse the IR expression AST (tpu_olap.ir.expr);
+aggregates parse to FuncCall nodes (count/sum/min/max/avg, COUNT(DISTINCT
+x) -> count_distinct, approx_count_distinct, theta_sketch). BETWEEN, IN,
+LIKE, IS [NOT] NULL, NOT/AND/OR are normalized into the same AST using
+comparison/logical BinOps plus marker FuncCalls (in_list, like, is_null).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+from tpu_olap.ir.expr import BinOp, Col, Expr, FuncCall, Lit
+
+AGG_FUNCS = {"count", "sum", "min", "max", "avg", "count_distinct",
+             "approx_count_distinct", "theta_sketch"}
+
+_TOKEN = re.compile(r"""
+    \s*(?:
+      (?P<num>\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)
+    | (?P<name>[A-Za-z_][A-Za-z0-9_]*(?:\.[A-Za-z_][A-Za-z0-9_]*)?)
+    | (?P<str>'(?:[^']|'')*')
+    | (?P<op><>|!=|<=|>=|=|<|>|\(|\)|,|\*|\+|-|/|%)
+    )""", re.VERBOSE)
+
+_KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "offset", "as", "and", "or", "not", "between", "in", "like", "is",
+    "null", "asc", "desc", "join", "inner", "left", "on", "distinct",
+}
+
+
+class SqlError(ValueError):
+    pass
+
+
+def _tokenize(s: str):
+    out, pos = [], 0
+    while pos < len(s):
+        if s[pos] == ";":
+            pos += 1
+            continue
+        m = _TOKEN.match(s, pos)
+        if not m:
+            if s[pos:].strip() == "":
+                break
+            raise SqlError(f"bad token near {s[pos:pos + 20]!r}")
+        pos = m.end()
+        if m.lastgroup == "num":
+            t = m.group("num")
+            out.append(("num",
+                        float(t) if "." in t or "e" in t.lower() else int(t)))
+        elif m.lastgroup == "name":
+            w = m.group("name")
+            if w.lower() in _KEYWORDS:
+                out.append(("kw", w.lower()))
+            else:
+                out.append(("name", w))
+        elif m.lastgroup == "str":
+            out.append(("str", m.group("str")[1:-1].replace("''", "'")))
+        else:
+            out.append(("op", m.group("op")))
+    out.append(("eof", None))
+    return out
+
+
+@dataclass
+class JoinClause:
+    table: str
+    on: Expr | None  # None for comma joins (condition lives in WHERE)
+    kind: str = "inner"
+
+
+@dataclass
+class OrderItem:
+    expr: Expr
+    descending: bool = False
+
+
+@dataclass
+class SelectStmt:
+    projections: list            # [(Expr, alias|None)]
+    table: str = ""
+    joins: list = field(default_factory=list)
+    where: Expr | None = None
+    group_by: list = field(default_factory=list)
+    having: Expr | None = None
+    order_by: list = field(default_factory=list)
+    limit: int | None = None
+    offset: int = 0
+    distinct: bool = False
+
+
+class _Parser:
+    def __init__(self, toks):
+        self.toks = toks
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i]
+
+    def at_kw(self, *kws):
+        k, v = self.peek()
+        return k == "kw" and v in kws
+
+    def take(self, kind=None, val=None):
+        k, v = self.toks[self.i]
+        if (kind and k != kind) or (val is not None and v != val):
+            raise SqlError(f"expected {val or kind}, got {v!r}")
+        self.i += 1
+        return v
+
+    def take_kw(self, kw):
+        return self.take("kw", kw)
+
+    # ---- statement -------------------------------------------------------
+
+    def select(self) -> SelectStmt:
+        self.take_kw("select")
+        stmt = SelectStmt(projections=[])
+        if self.at_kw("distinct"):
+            self.take()
+            stmt.distinct = True
+        while True:
+            if self.peek() == ("op", "*"):
+                self.take()
+                stmt.projections.append((Col("*"), None))
+            else:
+                e = self.expr()
+                alias = None
+                if self.at_kw("as"):
+                    self.take()
+                    alias = self.take("name")
+                elif self.peek()[0] == "name":
+                    alias = self.take("name")
+                stmt.projections.append((e, alias))
+            if self.peek() == ("op", ","):
+                self.take()
+                continue
+            break
+        self.take_kw("from")
+        stmt.table = self.take("name")
+        while True:
+            if self.peek() == ("op", ","):
+                self.take()
+                stmt.joins.append(JoinClause(self.take("name"), None))
+                continue
+            if self.at_kw("join", "inner", "left"):
+                kind = "inner"
+                if self.at_kw("left"):
+                    self.take()
+                    kind = "left"
+                elif self.at_kw("inner"):
+                    self.take()
+                self.take_kw("join")
+                tname = self.take("name")
+                self.take_kw("on")
+                cond = self.expr()
+                stmt.joins.append(JoinClause(tname, cond, kind))
+                continue
+            break
+        if self.at_kw("where"):
+            self.take()
+            stmt.where = self.expr()
+        if self.at_kw("group"):
+            self.take()
+            self.take_kw("by")
+            stmt.group_by.append(self.expr())
+            while self.peek() == ("op", ","):
+                self.take()
+                stmt.group_by.append(self.expr())
+        if self.at_kw("having"):
+            self.take()
+            stmt.having = self.expr()
+        if self.at_kw("order"):
+            self.take()
+            self.take_kw("by")
+            while True:
+                e = self.expr()
+                desc = False
+                if self.at_kw("asc"):
+                    self.take()
+                elif self.at_kw("desc"):
+                    self.take()
+                    desc = True
+                stmt.order_by.append(OrderItem(e, desc))
+                if self.peek() == ("op", ","):
+                    self.take()
+                    continue
+                break
+        if self.at_kw("limit"):
+            self.take()
+            stmt.limit = int(self.take("num"))
+        if self.at_kw("offset"):
+            self.take()
+            stmt.offset = int(self.take("num"))
+        if self.peek()[0] != "eof":
+            k, v = self.peek()
+            raise SqlError(f"unexpected {v!r} after statement")
+        return stmt
+
+    # ---- expressions -----------------------------------------------------
+
+    def expr(self) -> Expr:
+        return self.or_()
+
+    def or_(self):
+        e = self.and_()
+        while self.at_kw("or"):
+            self.take()
+            e = BinOp("||", e, self.and_())
+        return e
+
+    def and_(self):
+        e = self.not_()
+        while self.at_kw("and"):
+            self.take()
+            e = BinOp("&&", e, self.not_())
+        return e
+
+    def not_(self):
+        if self.at_kw("not"):
+            self.take()
+            return FuncCall("not", (self.not_(),))
+        return self.cmp()
+
+    def cmp(self):
+        e = self.add()
+        k, v = self.peek()
+        if k == "op" and v in ("=", "<>", "!=", "<", "<=", ">", ">="):
+            self.take()
+            op = {"=": "==", "<>": "!="}.get(v, v)
+            return BinOp(op, e, self.add())
+        if self.at_kw("between"):
+            self.take()
+            lo = self.add()
+            self.take_kw("and")
+            hi = self.add()
+            return BinOp("&&", BinOp(">=", e, lo), BinOp("<=", e, hi))
+        if self.at_kw("in"):
+            self.take()
+            self.take("op", "(")
+            vals = [self.add()]
+            while self.peek() == ("op", ","):
+                self.take()
+                vals.append(self.add())
+            self.take("op", ")")
+            return FuncCall("in_list", (e, *vals))
+        if self.at_kw("like"):
+            self.take()
+            pat = self.add()
+            return FuncCall("like", (e, pat))
+        if self.at_kw("not"):
+            # e NOT IN (...) / e NOT LIKE / e NOT BETWEEN
+            self.take()
+            inner = self._negatable(e)
+            return FuncCall("not", (inner,))
+        if self.at_kw("is"):
+            self.take()
+            neg = False
+            if self.at_kw("not"):
+                self.take()
+                neg = True
+            self.take_kw("null")
+            isnull = FuncCall("is_null", (e,))
+            return FuncCall("not", (isnull,)) if neg else isnull
+        return e
+
+    def _negatable(self, e):
+        if self.at_kw("in"):
+            self.take()
+            self.take("op", "(")
+            vals = [self.add()]
+            while self.peek() == ("op", ","):
+                self.take()
+                vals.append(self.add())
+            self.take("op", ")")
+            return FuncCall("in_list", (e, *vals))
+        if self.at_kw("like"):
+            self.take()
+            return FuncCall("like", (e, self.add()))
+        if self.at_kw("between"):
+            self.take()
+            lo = self.add()
+            self.take_kw("and")
+            hi = self.add()
+            return BinOp("&&", BinOp(">=", e, lo), BinOp("<=", e, hi))
+        raise SqlError("expected IN/LIKE/BETWEEN after NOT")
+
+    def add(self):
+        e = self.mul()
+        while self.peek()[0] == "op" and self.peek()[1] in ("+", "-"):
+            op = self.take()
+            e = BinOp(op, e, self.mul())
+        return e
+
+    def mul(self):
+        e = self.unary()
+        while self.peek()[0] == "op" and self.peek()[1] in ("*", "/", "%"):
+            op = self.take()
+            e = BinOp(op, e, self.unary())
+        return e
+
+    def unary(self):
+        if self.peek() == ("op", "-"):
+            self.take()
+            return BinOp("-", Lit(0), self.unary())
+        return self.atom()
+
+    def atom(self):
+        k, v = self.peek()
+        if k == "num":
+            self.take()
+            return Lit(v)
+        if k == "str":
+            self.take()
+            return Lit(v)
+        if k == "kw" and v == "null":
+            self.take()
+            return Lit(None)
+        if k == "name":
+            self.take()
+            if self.peek() == ("op", "("):
+                self.take()
+                fname = v.lower()
+                distinct = False
+                if self.at_kw("distinct"):
+                    self.take()
+                    distinct = True
+                args = []
+                if self.peek() == ("op", "*"):
+                    self.take()
+                elif self.peek() != ("op", ")"):
+                    args.append(self.expr())
+                    while self.peek() == ("op", ","):
+                        self.take()
+                        args.append(self.expr())
+                self.take("op", ")")
+                if distinct:
+                    if fname != "count":
+                        raise SqlError("DISTINCT only inside COUNT()")
+                    fname = "count_distinct"
+                return FuncCall(fname, tuple(args))
+            return Col(v)
+        if (k, v) == ("op", "("):
+            self.take()
+            e = self.expr()
+            self.take("op", ")")
+            return e
+        raise SqlError(f"unexpected token {v!r}")
+
+
+def parse_sql(sql: str) -> SelectStmt:
+    p = _Parser(_tokenize(sql))
+    return p.select()

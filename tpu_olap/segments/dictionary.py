@@ -17,12 +17,13 @@ NULL_ID = 0
 
 
 class Dictionary:
-    __slots__ = ("values", "_index")
+    __slots__ = ("values", "_index", "_value_hash_table")
 
     def __init__(self, values: np.ndarray):
         """values: sorted unique string array (no nulls)."""
         self.values = values
         self._index = None  # lazy value -> id dict
+        self._value_hash_table = None  # memoized crc32 table (kernels)
 
     @staticmethod
     def build(arr) -> tuple["Dictionary", np.ndarray]:
