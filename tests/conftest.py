@@ -7,11 +7,20 @@ initializes its backends, hence the env mutation at import time.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force the CPU backend with 8 virtual devices so multi-chip paths run
+# without hardware. The sandbox's sitecustomize imports jax at interpreter
+# startup with JAX_PLATFORMS=axon already snapshotted, so mutating the env
+# var here is too late — jax.config.update still works as long as no
+# backend has been initialized yet.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

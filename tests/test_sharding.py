@@ -1,0 +1,97 @@
+"""Multi-chip tests on the 8-virtual-device CPU mesh (SURVEY.md §5
+implication #4): sharded execution must agree exactly with single-device
+and with pandas."""
+
+import jax
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpu_olap import Engine
+from tpu_olap.executor import EngineConfig
+from tpu_olap.utils import timeutil as tu
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices")
+
+
+def build(num_shards=None):
+    rng = np.random.default_rng(3)
+    n = 20_000
+    t0 = tu.date_to_millis(1993, 1, 1)
+    df = pd.DataFrame({
+        "ts": pd.to_datetime(t0 + rng.integers(0, 2 * 365 * 86_400_000, n),
+                             unit="ms"),
+        "brand": rng.choice([f"B{i:02d}" for i in range(30)], n),
+        "region": rng.choice(["ASIA", "EUROPE", "AMERICA"], n),
+        "qty": rng.integers(1, 50, n).astype(np.int64),
+        "price": np.round(rng.uniform(0, 100, n), 2),
+        "uid": rng.integers(0, 3000, n).astype(np.int64),
+    })
+    eng = Engine(EngineConfig(num_shards=num_shards))
+    eng.register_table("f", df, time_column="ts", block_rows=1 << 11)
+    return eng, df
+
+
+QUERIES = [
+    "SELECT sum(qty) AS s, count() AS n FROM f",
+    """SELECT brand, sum(qty * price) AS rev FROM f
+       WHERE region = 'ASIA' GROUP BY brand""",
+    """SELECT region, min(price) AS mn, max(qty) AS mx, avg(price) AS av
+       FROM f GROUP BY region""",
+    """SELECT year(ts) AS yr, count() AS n FROM f GROUP BY year(ts)""",
+    """SELECT brand, sum(qty) AS s FROM f GROUP BY brand
+       ORDER BY s DESC LIMIT 5""",
+    """SELECT count() AS n FROM f WHERE ts >= '1993-06-01'
+       AND ts < '1994-02-01'""",
+]
+
+
+@pytest.mark.parametrize("idx", range(len(QUERIES)))
+def test_sharded_matches_single(idx):
+    sql = QUERIES[idx]
+    e1, _ = build(num_shards=None)
+    e8, _ = build(num_shards=8)
+    a = e1.sql(sql)
+    b = e8.sql(sql)
+    assert e8.last_plan.rewritten, e8.last_plan.fallback_reason
+    assert e8.runner.history[-1]["num_shards"] == 8
+    pd.testing.assert_frame_equal(a, b)
+
+
+def test_sharded_theta_matches_single():
+    from tpu_olap.ir import (ThetaSketchAggregation, TimeseriesQuerySpec,
+                             GroupByQuerySpec, DefaultDimensionSpec)
+    q = GroupByQuerySpec(
+        data_source="f", dimensions=(DefaultDimensionSpec("region"),),
+        aggregations=(ThetaSketchAggregation("u", "uid", 1 << 12),))
+    e1, df = build(num_shards=None)
+    e8, _ = build(num_shards=8)
+    r1 = e1.execute_ir(q)
+    r8 = e8.execute_ir(q)
+    assert r1.rows == r8.rows
+    truth = df.groupby("region").uid.nunique()
+    for r in r8.rows:
+        want = truth[r["region"]]
+        assert abs(r["u"] - want) / want < 0.1, (r, want)
+
+
+def test_sharded_hll_and_scan():
+    e8, df = build(num_shards=8)
+    out = e8.sql("SELECT count(DISTINCT uid) AS u FROM f")
+    want = df.uid.nunique()
+    assert abs(out.u[0] - want) / want < 0.1
+    scan = e8.sql("SELECT brand, qty FROM f WHERE qty = 49 LIMIT 12")
+    truth = df.sort_values("ts", kind="stable")
+    truth = truth[truth.qty == 49]
+    assert scan.qty.tolist() == truth.qty.head(12).tolist()
+    assert scan.brand.tolist() == truth.brand.head(12).tolist()
+
+
+def test_sharded_pruning_still_correct():
+    e8, df = build(num_shards=8)
+    out = e8.sql("SELECT count() AS n FROM f WHERE year(ts) = 1994")
+    years = pd.to_datetime(df.ts).dt.year
+    assert out.n[0] == int((years == 1994).sum())
+    m = e8.runner.history[-1]
+    assert m["segments_scanned"] < m["segments_total"]

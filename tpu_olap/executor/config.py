@@ -32,6 +32,11 @@ class EngineConfig:
     # execution platform: "device" = default jax backend, "cpu" = numpy path
     platform: str = "device"
 
+    # multi-chip: shard the segment axis across this many devices on a 1-D
+    # mesh (None/1 = single device). The analog of the reference's
+    # queryHistoricalServers fan-out (SURVEY.md §3.5 P2).
+    num_shards: int | None = None
+
     # emit empty time buckets in timeseries results (Druid default)
     skip_empty_buckets: bool = False
 

@@ -15,49 +15,68 @@ from tpu_olap.segments.segment import TableSegments
 
 
 class DeviceDataset:
-    """Lazy per-column stacks for one table on one platform."""
+    """Lazy per-column stacks for one table on one platform.
 
-    def __init__(self, table: TableSegments, platform: str = "device"):
+    With a mesh, stacks are padded to a multiple of the shard count with
+    fully-invalid segments and device_put sharded on the segment axis —
+    every chip holds 1/D of each column in its HBM.
+    """
+
+    def __init__(self, table: TableSegments, platform: str = "device",
+                 mesh=None):
         self.table = table
         self.platform = platform
+        self.mesh = mesh
         self._cols: dict[str, object] = {}
         self._nulls: dict[str, object] = {}
         self._valid = None
         n_seg = len(table.segments)
+        if mesh is not None:
+            from tpu_olap.executor.sharding import pad_segments
+            n_seg = pad_segments(max(n_seg, 1), mesh.devices.size)
         self.shape = (n_seg, table.block_rows)
 
     def _put(self, arr: np.ndarray):
         if self.platform == "cpu":
             return arr
         import jax
+        if self.mesh is not None:
+            from tpu_olap.executor.sharding import shard_put
+            return shard_put(arr, self.mesh)
         return jax.device_put(arr)
+
+    def _stack(self, per_segment, dtype=None) -> np.ndarray:
+        rows = [per_segment(s) for s in self.table.segments]
+        fill = self.shape[0] - len(rows)
+        if fill > 0:
+            proto = rows[0] if rows else np.zeros(self.table.block_rows,
+                                                  dtype or np.int32)
+            rows = rows + [np.zeros_like(proto)] * fill
+        return np.stack(rows)
 
     def col(self, name: str):
         if name not in self._cols:
-            stack = np.stack([s.columns[name] for s in self.table.segments])
-            self._cols[name] = self._put(stack)
+            self._cols[name] = self._put(
+                self._stack(lambda s: s.columns[name]))
         return self._cols[name]
 
     def null_mask(self, name: str):
         """None if the column has no nulls anywhere."""
         if name not in self._nulls:
             if any(name in s.null_masks for s in self.table.segments):
-                stack = np.stack([
-                    s.null_masks.get(name,
-                                     np.zeros(self.table.block_rows, bool))
-                    for s in self.table.segments])
-                self._nulls[name] = self._put(stack)
+                zero = np.zeros(self.table.block_rows, bool)
+                self._nulls[name] = self._put(
+                    self._stack(lambda s: s.null_masks.get(name, zero)))
             else:
                 self._nulls[name] = None
         return self._nulls[name]
 
     def valid(self):
-        """[S, R] row-validity (padding rows are False)."""
+        """[S, R] row-validity (padding rows/segments are False)."""
         if self._valid is None:
             r = np.arange(self.table.block_rows)
-            stack = np.stack([r < s.meta.n_valid
-                              for s in self.table.segments])
-            self._valid = self._put(stack)
+            self._valid = self._put(
+                self._stack(lambda s: r < s.meta.n_valid, bool))
         return self._valid
 
     def segment_mask(self, kept_ids) -> np.ndarray:

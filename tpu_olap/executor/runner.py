@@ -46,9 +46,22 @@ class QueryRunner:
     def __init__(self, config: EngineConfig | None = None):
         self.config = config or EngineConfig()
         self.config.apply_x64()
+        if self.config.platform == "cpu" and (self.config.num_shards or 1) > 1:
+            raise ValueError(
+                "num_shards > 1 requires the jax device platform; the "
+                "numpy path ('cpu') is single-shard by construction")
         self._datasets: dict = {}
         self._jit_cache: dict = {}
+        self._mesh = None
         self.history: list = []
+
+    @property
+    def mesh(self):
+        if self._mesh is None and self.config.platform != "cpu" and \
+                (self.config.num_shards or 1) > 1:
+            from tpu_olap.executor.sharding import make_mesh
+            self._mesh = make_mesh(self.config.num_shards)
+        return self._mesh
 
     # ------------------------------------------------------------------ API
 
@@ -92,7 +105,7 @@ class QueryRunner:
         key = table.name
         ds = self._datasets.get(key)
         if ds is None or ds.table is not table:
-            ds = DeviceDataset(table, self.config.platform)
+            ds = DeviceDataset(table, self.config.platform, self.mesh)
             self._datasets[key] = ds
         return ds
 
@@ -114,20 +127,32 @@ class QueryRunner:
                               plan.pool.consts)
             metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
             metrics["cache_hit"] = False
+            metrics["num_shards"] = 1
             return {k: np.asarray(v) for k, v in out.items()}
 
         import jax
-        key = plan.fingerprint()
+        mesh = self.mesh
+        key = plan.fingerprint() + ((mesh.devices.size,) if mesh else ())
         jitted = self._jit_cache.get(key)
         hit = jitted is not None
         if not hit:
-            jitted = jax.jit(plan.kernel)
+            if mesh is not None:
+                from tpu_olap.executor.sharding import sharded_kernel
+                jitted = jax.jit(sharded_kernel(plan, mesh))
+            else:
+                jitted = jax.jit(plan.kernel)
             self._jit_cache[key] = jitted
         t0 = time.perf_counter()
-        out = jitted(env, valid, jax.device_put(seg_mask), plan.pool.consts)
+        if mesh is not None:
+            from tpu_olap.executor.sharding import shard_put
+            seg_arg = shard_put(seg_mask, mesh)
+        else:
+            seg_arg = jax.device_put(seg_mask)
+        out = jitted(env, valid, seg_arg, plan.pool.consts)
         out = {k: np.asarray(v) for k, v in out.items()}
         metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
         metrics["cache_hit"] = hit
+        metrics["num_shards"] = mesh.devices.size if mesh else 1
         return out
 
     # ------------------------------------------------------------ agg paths
@@ -286,8 +311,8 @@ class QueryRunner:
         plan = lower(query, table, self.config)
         metrics["lower_ms"] = (time.perf_counter() - t0) * 1000
         partials = self._run_partials(plan, metrics)
-        mask = partials["mask"].reshape(len(table.segments),
-                                        table.block_rows)
+        mask = partials["mask"].reshape(-1, table.block_rows)
+        mask = mask[:len(table.segments)]  # drop shard-padding segments
 
         t0 = time.perf_counter()
         if isinstance(query, ScanQuerySpec):

@@ -1,0 +1,116 @@
+"""Multi-chip execution: segment-sharded data parallelism over a Mesh.
+
+The TPU-native replacement for the reference's direct-historical fan-out
+(SURVEY.md §3.5 P2): segments shard across chips on a 1-D 'data' mesh axis
+(the analog of one partition per historical), each chip computes partial
+dense group tables over its local segments, and the "Spark final merge
+aggregate" becomes XLA collectives over ICI — psum for sums/counts, pmax/
+pmin for extremes and HLL registers, an all_gather + fold for theta
+sketches (SURVEY.md §3.6 transport summary; BASELINE.json:5 "partial
+aggregates allreduce over ICI").
+
+The dense group table is what makes this an allreduce instead of a hash
+exchange: group ids are global (dictionary codes × calendar buckets), so no
+chip ever needs another chip's rows — only its [K] table. High-cardinality
+GROUP BY beyond the dense budget falls back (SURVEY.md §8.4 #1); a
+hash-exchange path is future work.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_olap.kernels import theta as theta_mod
+
+DATA_AXIS = "data"
+
+
+def make_mesh(num_shards: int) -> Mesh:
+    devs = jax.devices()
+    if num_shards > len(devs):
+        raise ValueError(
+            f"num_shards={num_shards} exceeds {len(devs)} devices")
+    return Mesh(np.array(devs[:num_shards]), (DATA_AXIS,))
+
+
+def merge_collective(out: dict, agg_plans, axis: str = DATA_AXIS) -> dict:
+    """Merge per-chip partial aggregates across the mesh axis — the same
+    ops as kernels.groupby.merge_partials, as collectives."""
+    merged = {"_rows": jax.lax.psum(out["_rows"], axis)}
+    for p in agg_plans:
+        v = out[p.name]
+        if p.kind in ("count", "sum"):
+            merged[p.name] = jax.lax.psum(v, axis)
+        elif p.kind == "min":
+            merged[p.name] = jax.lax.pmin(v, axis)
+        elif p.kind in ("max", "hll"):
+            merged[p.name] = jax.lax.pmax(v, axis)
+        elif p.kind == "theta":
+            g = jax.lax.all_gather(v, axis)  # [D, K, k]
+            acc = g[0]
+            for i in range(1, g.shape[0]):
+                acc = theta_mod.theta_merge(acc, g[i], jnp)
+            merged[p.name] = acc
+        else:
+            raise AssertionError(p.kind)
+        nn = f"_nn_{p.name}"
+        if nn in out:
+            merged[nn] = jax.lax.psum(out[nn], axis)
+    return merged
+
+
+def sharded_kernel(plan, mesh: Mesh):
+    """Wrap a PhysicalPlan kernel in shard_map over the segment axis.
+
+    Inputs arrive sharded on their leading (segment) dim; consts are
+    replicated; outputs are replicated merged tables (every chip holds the
+    final answer — the host reads one copy).
+    """
+    kernel = plan.kernel
+    agg_plans = plan.agg_plans
+    is_mask = plan.kind == "mask"
+
+    def local(env, valid, seg_mask, consts):
+        out = kernel(env, valid, seg_mask, consts)
+        if is_mask:
+            return out  # row masks stay sharded; host gathers per shard
+        return merge_collective(out, agg_plans)
+
+    def specs_like(env):
+        return {
+            "cols": {k: P(DATA_AXIS) for k in env["cols"]},
+            "nulls": {k: P(DATA_AXIS) for k in env["nulls"]},
+        }
+
+    def run(env, valid, seg_mask, consts):
+        f = jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(specs_like(env), P(DATA_AXIS), P(DATA_AXIS),
+                      jax.tree.map(lambda _: P(), consts)),
+            out_specs=(jax.tree.map(lambda _: P(DATA_AXIS), {"mask": 0})
+                       if is_mask else P()),
+            # the theta merge (all_gather + fold) is replicated by
+            # construction but defeats static replication inference
+            check_vma=False,
+        )
+        return f(env, valid, seg_mask, consts)
+
+    return run
+
+
+def shard_put(arr: np.ndarray, mesh: Mesh):
+    """Host array -> device array sharded on the leading axis."""
+    return jax.device_put(arr, NamedSharding(mesh, P(DATA_AXIS)))
+
+
+def replicate_put(arr, mesh: Mesh):
+    return jax.device_put(arr, NamedSharding(mesh, P()))
+
+
+def pad_segments(n_segments: int, num_shards: int) -> int:
+    """Segments must split evenly across shards; padded blocks are fully
+    invalid rows (valid mask False), so results are unaffected."""
+    return -(-n_segments // num_shards) * num_shards
