@@ -1,0 +1,63 @@
+"""SSB suite: all 13 queries rewrite to the device path and agree with the
+pandas fallback row-for-row — the analog of the reference's plan-level
+rewrite assertions + live-Druid parity runs (SURVEY.md §5), on the
+driver's north-star workload (BASELINE.json:2)."""
+
+import pytest
+
+from tpu_olap import Engine
+from tpu_olap.bench import QUERIES, check_query, register_ssb
+from tpu_olap.bench.parity import ParityError, run_both
+from tpu_olap.ir.query import GroupByQuerySpec, TimeseriesQuerySpec
+
+
+@pytest.fixture(scope="module")
+def ssb_engine():
+    eng = Engine()
+    register_ssb(eng, lineorder_rows=30_000, seed=7, block_rows=1 << 12)
+    return eng
+
+
+@pytest.mark.parametrize("qname", sorted(QUERIES))
+def test_ssb_parity(ssb_engine, qname):
+    check_query(ssb_engine, QUERIES[qname], label=qname)
+
+
+def test_q1_rewrites_to_timeseries(ssb_engine):
+    _, _, plan = run_both(ssb_engine, QUERIES["q1.1"])
+    assert isinstance(plan.query, TimeseriesQuerySpec)
+    # the d_year filter rides the denormalized column, joins are gone
+    assert plan.query.data_source == "lineorder"
+
+
+@pytest.mark.parametrize("qname", ["q2.1", "q3.1", "q4.1"])
+def test_star_queries_rewrite_to_groupby(ssb_engine, qname):
+    _, _, plan = run_both(ssb_engine, QUERIES[qname])
+    assert isinstance(plan.query, GroupByQuerySpec)
+
+
+def test_nonempty_results(ssb_engine):
+    # guard against silently-empty parity: the generator must produce rows
+    # that satisfy each query's filters
+    for qname, sql in QUERIES.items():
+        df = ssb_engine.sql(sql)
+        assert len(df) > 0, f"{qname} returned no rows"
+
+
+def test_undeclared_join_falls_back(ssb_engine):
+    # join that is NOT a declared star FK edge -> transparent fallback
+    sql = """
+        SELECT sum(lo_revenue) AS r FROM lineorder
+        JOIN part ON lo_suppkey = p_partkey
+    """
+    df = ssb_engine.sql(sql)
+    assert not ssb_engine.last_plan.rewritten
+    assert len(df) == 1
+
+
+def test_parity_error_reports_query(ssb_engine):
+    with pytest.raises(ParityError):
+        run_both(ssb_engine, """
+            SELECT sum(lo_revenue) AS r FROM lineorder
+            JOIN part ON lo_suppkey = p_partkey
+        """)
