@@ -1,0 +1,105 @@
+"""Cost model (planner.cost): the DruidQueryCostModel analog — strategy
+choice between explicit shard_map partials ("historicals") and
+whole-program GSPMD ("broker"), and its integration into execution and
+EXPLAIN (SURVEY.md §3.2, §6)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpu_olap import Engine
+from tpu_olap.bench.parity import check_query
+from tpu_olap.executor import EngineConfig
+from tpu_olap.planner import cost as cost_mod
+
+
+def _table(n=4096, seed=3):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "ts": pd.to_datetime(rng.integers(725846400000, 757382400000, n),
+                             unit="ms"),
+        "dim": rng.choice([f"d{i}" for i in range(30)], n),
+        "val": rng.integers(0, 1000, n).astype(np.int64),
+    })
+
+
+def _plan_for(eng, sql):
+    from tpu_olap.executor.lowering import lower
+    plan = eng.planner.plan(sql)
+    assert plan.rewritten, plan.fallback_reason
+    return lower(plan.query, plan.entry.segments, eng.config)
+
+
+def test_small_groupby_prefers_historicals():
+    eng = Engine()
+    eng.register_table("t", _table(), time_column="ts", block_rows=512)
+    phys = _plan_for(eng, "SELECT dim, sum(val) AS s FROM t GROUP BY dim")
+    d = cost_mod.decide(phys, eng.config, shards=8)
+    assert d.strategy == "historicals"
+    assert d.shards == 8
+    assert d.groups <= 64
+
+
+def test_sketch_heavy_table_prefers_broker():
+    # HLL state is [groups x 2048] int32: with enough groups the explicit
+    # allreduce dominates any scan of a few thousand rows
+    eng = Engine()
+    eng.register_table("t", _table(), time_column="ts", block_rows=512)
+    phys = _plan_for(eng, """
+        SELECT dim, val, count(DISTINCT dim) AS u
+        FROM t GROUP BY dim, val
+    """)
+    d = cost_mod.decide(phys, eng.config, shards=8)
+    assert d.table_bytes > 100 * d.rows_scanned
+    assert d.strategy == "broker"
+
+
+def test_disabled_model_pins_historicals():
+    eng = Engine(EngineConfig(cost_model_enabled=False))
+    eng.register_table("t", _table(), time_column="ts", block_rows=512)
+    phys = _plan_for(eng, """
+        SELECT dim, val, count(DISTINCT dim) AS u
+        FROM t GROUP BY dim, val
+    """)
+    d = cost_mod.decide(phys, eng.config, shards=8)
+    assert d.strategy == "historicals"
+    assert d.reason == "cost model disabled"
+
+
+def test_single_device_is_trivially_historicals():
+    eng = Engine()
+    eng.register_table("t", _table(), time_column="ts", block_rows=512)
+    phys = _plan_for(eng, "SELECT sum(val) AS s FROM t")
+    d = cost_mod.decide(phys, eng.config, shards=1)
+    assert d.strategy == "historicals"
+    assert d.merge_us == 0.0
+
+
+@pytest.mark.parametrize("strategy", ["historicals", "broker"])
+def test_both_strategies_agree_with_fallback(strategy, monkeypatch):
+    eng = Engine(EngineConfig(num_shards=8))
+    eng.register_table("t", _table(), time_column="ts", block_rows=512)
+    orig = cost_mod.decide
+
+    def force(plan, config, shards):
+        d = orig(plan, config, shards)
+        return cost_mod.CostDecision(strategy, d.shards, d.rows_scanned,
+                                     d.groups, d.table_bytes, d.scan_us,
+                                     d.merge_us, "forced by test")
+    monkeypatch.setattr(cost_mod, "decide", force)
+    check_query(eng, """
+        SELECT dim, sum(val) AS s, count() AS n, min(val) AS lo
+        FROM t GROUP BY dim ORDER BY dim
+    """, label=f"strategy={strategy}")
+    m = eng.runner.history[-1]
+    assert m["cost"]["strategy"] == strategy
+    assert m["num_shards"] == 8
+
+
+def test_explain_includes_cost():
+    eng = Engine()
+    eng.register_table("t", _table(), time_column="ts", block_rows=512)
+    out = eng.explain("SELECT dim, sum(val) AS s FROM t GROUP BY dim")
+    assert out["rewritten"]
+    assert out["cost"]["strategy"] == "historicals"
+    assert out["cost"]["rowsScanned"] > 0

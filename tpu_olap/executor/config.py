@@ -29,6 +29,12 @@ class EngineConfig:
     # segments per device dispatch (flattened rows = batch × block_rows)
     max_segments_per_dispatch: int = 1 << 10
 
+    # packed results: max non-empty groups shipped back per query in the
+    # single-fetch compacted buffer (executor.packing). Queries whose
+    # result exceeds this transparently re-run unpacked (slower transfer,
+    # same answer).
+    result_group_cap: int = 1 << 16
+
     # execution platform: "device" = default jax backend, "cpu" = numpy path
     platform: str = "device"
 

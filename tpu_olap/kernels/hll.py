@@ -68,15 +68,19 @@ def _wide_ints(xp) -> bool:
     return has_x64(xp)
 
 
-def hll_estimate(registers: np.ndarray) -> np.ndarray:
-    """[K, m] registers -> [K] float estimates (host-side finalize)."""
-    regs = np.asarray(registers, np.float64)
+def hll_estimate(registers, xp=np, float_dtype=np.float64):
+    """[K, m] registers -> [K] float estimates. Runs host-side (xp=np) or
+    on device inside the packed-result program (xp=jnp) — finalizing on
+    device keeps the per-query host fetch to one small buffer."""
+    ft = np.dtype(float_dtype).type
+    regs = xp.asarray(registers).astype(float_dtype)
     m = NUM_REGISTERS
-    inv = np.power(2.0, -regs).sum(axis=-1)
-    est = _ALPHA * m * m / inv
+    inv = xp.power(ft(2.0), -regs).sum(axis=-1)
+    est = ft(_ALPHA * m * m) / inv
     zeros = (regs == 0).sum(axis=-1)
     small = est <= 2.5 * m
-    with np.errstate(divide="ignore"):
-        lc = m * np.log(np.where(zeros > 0, m / np.maximum(zeros, 1), 1.0))
-    est = np.where(small & (zeros > 0), lc, est)
+    lc = m * xp.log(xp.where(zeros > 0,
+                             m / xp.maximum(zeros, 1).astype(float_dtype),
+                             ft(1.0)))
+    est = xp.where(small & (zeros > 0), lc, est)
     return est

@@ -64,16 +64,17 @@ def theta_merge(a, b, xp):
     return both[..., :k]
 
 
-def theta_estimate(table: np.ndarray) -> np.ndarray:
-    """[K, k] sorted unit-hash table -> [K] float estimates (host)."""
-    t = np.asarray(table, np.float64)
+def theta_estimate(table, xp=np, float_dtype=np.float64):
+    """[K, k] sorted unit-hash table -> [K] float estimates. Host (xp=np)
+    or on-device finalize for the packed-result path (xp=jnp)."""
+    ft = np.dtype(float_dtype).type
+    t = xp.asarray(table).astype(float_dtype)
     k = t.shape[-1]
     count = (t < EMPTY).sum(axis=-1)
     full = count >= k
     theta = t[..., -1]
-    with np.errstate(divide="ignore", invalid="ignore"):
-        est_full = (k - 1) / np.maximum(theta, 1e-300)
-    return np.where(full, est_full, count.astype(np.float64))
+    est_full = ft(k - 1) / xp.maximum(theta, ft(1e-30))
+    return xp.where(full, est_full, count.astype(float_dtype))
 
 
 def _seg_min(v, key, n, xp):
