@@ -5,22 +5,12 @@ hardware via XLA's host-platform device-count flag. Must run before jax
 initializes its backends, hence the env mutation at import time.
 """
 
-import os
-
 # Force the CPU backend with 8 virtual devices so multi-chip paths run
-# without hardware. The sandbox's sitecustomize imports jax at interpreter
-# startup with JAX_PLATFORMS=axon already snapshotted, so mutating the env
-# var here is too late — jax.config.update still works as long as no
-# backend has been initialized yet.
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+# without hardware (see tpu_olap.utils.platform for why env vars alone
+# are not enough in this sandbox).
+from tpu_olap.utils.platform import force_cpu_devices  # noqa: E402
 
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+force_cpu_devices(8)  # raises if a backend beat us to initialization
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

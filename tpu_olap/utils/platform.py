@@ -1,0 +1,70 @@
+"""Sandbox/platform helpers shared by tests, bench, and driver entries.
+
+The sandbox's sitecustomize registers the accelerator PJRT plugin at
+interpreter startup with the platform env already snapshotted, so exporting
+``JAX_PLATFORMS=cpu`` from a caller is not always enough to avoid
+initializing it; ``jax.config.update('jax_platforms', 'cpu')`` works as
+long as no backend has been initialized yet. This module is the single
+home for that workaround (used by tests/conftest.py, bench.py, and
+__graft_entry__.py) so the three drivers cannot drift.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def ensure_host_device_count(n: int) -> None:
+    """Set (or raise) the virtual host-platform device count to >= n.
+
+    Only effective before jax initializes its backends; a no-op when the
+    flag is already >= n.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
+        if "xla_force_host_platform_device_count" in flags:
+            return  # caller set it in a spelling we don't parse; trust it
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+    elif int(m.group(1)) < n:
+        os.environ["XLA_FLAGS"] = flags.replace(
+            m.group(0), f"--xla_force_host_platform_device_count={n}")
+
+
+def force_cpu_platform() -> bool:
+    """Force jax onto the CPU platform; True if the config took effect.
+
+    Safe to call when a backend is already up (returns False then — the
+    caller decides whether the current platform is acceptable).
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        return True
+    except Exception:
+        return False
+
+
+def force_cpu_devices(n: int) -> None:
+    """Ensure >= n JAX devices exist on the virtual-CPU platform."""
+    ensure_host_device_count(n)
+    force_cpu_platform()
+    import jax
+
+    if jax.device_count() < n:
+        raise RuntimeError(
+            f"need {n} devices, have {jax.devices()}; set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n} "
+            "JAX_PLATFORMS=cpu before jax initializes")
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    """Parse a 0/1/true/false-style env flag."""
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() not in ("", "0", "false", "no", "off")
