@@ -1,0 +1,133 @@
+"""Pallas fused one-hot reduce: parity vs the XLA scatter path.
+
+Runs the kernel in interpret mode on the CPU backend (the conftest forces
+the virtual-CPU platform), mirroring the reference's plan-level testing
+philosophy (SURVEY.md §5): same engine, two physical execution strategies,
+identical results required.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpu_olap import Engine
+from tpu_olap.executor import EngineConfig
+from tpu_olap.executor.lowering import lower
+from tpu_olap.kernels.pallas_reduce import expr_int_bounds
+from tpu_olap.ir.expr import BinOp, Col, Lit
+
+
+def _table(n=4096, seed=3):
+    rng = np.random.default_rng(seed)
+    df = pd.DataFrame({
+        "ts": pd.to_datetime("2020-01-01")
+        + pd.to_timedelta(rng.integers(0, 86400 * 200, n), unit="s"),
+        "color": rng.choice(["red", "green", "blue", None], n),
+        "region": rng.choice([f"r{i}" for i in range(12)], n),
+        "qty": rng.integers(0, 50, n).astype(np.int64),
+        "price": rng.integers(0, 10_000, n).astype(np.int64),
+    })
+    df.loc[rng.random(n) < 0.05, "qty"] = np.nan  # nullable numeric
+    df["qty"] = df["qty"].astype("Int64")
+    return df
+
+
+def _engines():
+    plain = Engine(EngineConfig(use_pallas="never"))
+    forced = Engine(EngineConfig(use_pallas="force"))
+    df = _table()
+    for e in (plain, forced):
+        e.register_table("t", df, time_column="ts", block_rows=512)
+    return plain, forced
+
+
+QUERIES = [
+    # single-group total with arithmetic projection + filters (Q1.1 shape)
+    """SELECT sum(price * qty) AS rev, count(*) AS n FROM t
+       WHERE qty BETWEEN 1 AND 25 AND price < 5000""",
+    # group by string dim
+    """SELECT color, sum(price) AS s, count(*) AS n FROM t
+       GROUP BY color ORDER BY color""",
+    # two dims incl. numeric-range dim + IN filter
+    """SELECT region, qty, sum(price) AS s FROM t
+       WHERE region IN ('r1','r2','r3') GROUP BY region, qty
+       ORDER BY region, qty""",
+    # filtered aggregator via CASE-less SQL: WHERE-free filtered sums
+    """SELECT color, count(*) AS n FROM t
+       WHERE NOT (region = 'r5' OR region = 'r6')
+       GROUP BY color ORDER BY color""",
+    # negative-capable sum (biased half-plane path, the SSB Q4.x profit
+    # shape: revenue - cost can go below zero)
+    """SELECT color, sum(price - qty * 300) AS profit FROM t
+       GROUP BY color ORDER BY color""",
+]
+
+
+@pytest.mark.parametrize("sql", QUERIES)
+def test_pallas_parity(sql):
+    plain, forced = _engines()
+    a = plain.sql(sql)
+    assert plain.last_plan.rewritten
+    b = forced.sql(sql)
+    assert forced.last_plan.rewritten
+    pd.testing.assert_frame_equal(a, b)
+
+
+def test_pallas_kernel_is_active():
+    _, forced = _engines()
+    q = "SELECT color, sum(price) AS s FROM t GROUP BY color"
+    plan = forced.planner.plan(q)
+    phys = lower(plan.query, plan.entry.segments, forced.config)
+    assert phys.pallas_reason is None
+    assert "pallas" in phys.statics
+
+
+def test_pallas_ineligible_falls_back():
+    _, forced = _engines()
+    # avg -> sum over a DOUBLE-typed virtual division? use min: not a sum
+    q = "SELECT color, min(price) AS m FROM t GROUP BY color"
+    plan = forced.planner.plan(q)
+    phys = lower(plan.query, plan.entry.segments, forced.config)
+    assert phys.pallas_reason is not None
+    assert "pallas" not in phys.statics
+    # still correct via the generic kernel
+    plain, _ = _engines()
+    pd.testing.assert_frame_equal(plain.sql(q), forced.sql(q))
+
+
+def test_pallas_group_cap_guard():
+    plain = Engine(EngineConfig(use_pallas="never"))
+    forced = Engine(EngineConfig(use_pallas="force", pallas_group_cap=4))
+    df = _table()
+    for e in (plain, forced):
+        e.register_table("t", df, time_column="ts", block_rows=512)
+    q = "SELECT region, count(*) AS n FROM t GROUP BY region ORDER BY region"
+    phys_plan = forced.planner.plan(q)
+    phys = lower(phys_plan.query, phys_plan.entry.segments, forced.config)
+    assert "exceeds pallas cap" in phys.pallas_reason
+    pd.testing.assert_frame_equal(plain.sql(q), forced.sql(q))
+
+
+def test_expr_int_bounds():
+    b = {"x": (0, 10), "y": (-5, 5)}
+    assert expr_int_bounds(Col("x"), b) == (0, 10)
+    assert expr_int_bounds(BinOp("*", Col("x"), Col("y")), b) == (-50, 50)
+    assert expr_int_bounds(BinOp("+", Col("x"), Lit(7)), b) == (7, 17)
+    assert expr_int_bounds(BinOp("-", Col("x"), Col("y")), b) == (-5, 15)
+    assert expr_int_bounds(BinOp("/", Col("x"), Lit(2)), b) is None
+    assert expr_int_bounds(Col("z"), b) is None
+    assert expr_int_bounds(Lit(1.5), b) is None
+
+
+def test_pallas_multichip_parity():
+    """Pallas kernel under shard_map over the 8-device virtual mesh."""
+    plain = Engine(EngineConfig(use_pallas="never"))
+    forced = Engine(EngineConfig(use_pallas="force", num_shards=8))
+    df = _table()
+    for e in (plain, forced):
+        e.register_table("t", df, time_column="ts", block_rows=256)
+    q = """SELECT color, sum(price) AS s, count(*) AS n FROM t
+           WHERE qty < 30 GROUP BY color ORDER BY color"""
+    a = plain.sql(q)
+    b = forced.sql(q)
+    pd.testing.assert_frame_equal(a, b)

@@ -61,6 +61,15 @@ class EngineConfig:
     cost_model_enabled: bool = True
     shard_merge_factor: float = 1.0
 
+    # Pallas fused one-hot MXU reduce (kernels.pallas_reduce): "auto" uses
+    # it on the TPU backend for eligible plans, "force" uses it everywhere
+    # eligible (interpret mode off-TPU — for tests), "never" disables.
+    use_pallas: str = "auto"
+    # max dense group count the one-hot [K, rows] tile may span — beyond
+    # this the VPU compare cost beats scatter anyway (K·N comparisons)
+    pallas_group_cap: int = 2048
+    pallas_rows_per_block: int = 1024
+
     extra: dict = field(default_factory=dict)
 
     def apply_x64(self):

@@ -50,6 +50,7 @@ class PhysicalPlan:
     columns: tuple = ()        # physical columns the kernel reads
     null_cols: tuple = ()
     virtual_exprs: dict = field(default_factory=dict)
+    pallas_reason: str | None = "not attempted"  # None = pallas kernel active
 
     def fingerprint(self) -> tuple:
         import json
@@ -232,12 +233,46 @@ def _lower_agg(query, table, config) -> PhysicalPlan:
                tuple((p.kind, p.name) for p in agg_plans),
                filter_fn is not None, imask_fn is not None)
 
-    return PhysicalPlan(
+    plan = PhysicalPlan(
         query=query, table=table, kind="agg", pool=pool, kernel=kernel,
         statics=statics, dim_plans=dim_plans, bucket_plan=bucket_plan,
         agg_plans=agg_plans, sizes=sizes, total_groups=total,
         pruned_ids=pruned, t_min=t_min, t_max=t_max, empty=empty,
         columns=columns, null_cols=null_cols, virtual_exprs=vexprs)
+    _maybe_use_pallas(plan, query, table, config, filter_fn)
+    return plan
+
+
+def _maybe_use_pallas(plan, query, table, config, filter_fn):
+    """Swap the generic jnp kernel for the fused Pallas one-hot MXU reduce
+    when the plan fits its envelope (kernels.pallas_reduce). The numpy
+    ("cpu" platform) path never uses it; "auto" additionally requires the
+    TPU backend — interpret mode is for tests ("force"), not production."""
+    if config.use_pallas not in ("auto", "force", "never"):
+        raise ValueError(
+            f"use_pallas must be 'auto', 'force', or 'never'; got "
+            f"{config.use_pallas!r}")
+    if config.use_pallas == "never" or config.platform == "cpu":
+        return
+    from tpu_olap.kernels import pallas_reduce
+
+    reason = pallas_reduce.eligible(query, plan, table, config)
+    if reason is not None:
+        plan.pallas_reason = reason
+        return
+    on_tpu = _default_backend() == "tpu"
+    if config.use_pallas == "auto" and not on_tpu:
+        plan.pallas_reason = "auto: backend is not tpu"
+        return
+    plan.kernel = pallas_reduce.build_kernel(plan, table, config, filter_fn,
+                                             interpret=not on_tpu)
+    plan.statics = plan.statics + ("pallas",)
+    plan.pallas_reason = None
+
+
+def _default_backend() -> str:
+    import jax
+    return jax.default_backend()
 
 
 def _lower_mask(query, table, config) -> PhysicalPlan:

@@ -1,0 +1,396 @@
+"""Pallas TPU kernel: fused filter -> group key -> one-hot MXU reduce.
+
+The in-tree "native tier" replacing Druid's segment-engine hot loop
+(SURVEY.md §3.7): where the jnp path lowers the grouped reduce to XLA
+scatter-adds (`jax.ops.segment_sum`), this kernel rides the MXU instead —
+a masked one-hot of the dense group key contracted against the aggregate
+inputs — and fuses the whole per-row pipeline (validity/filter masks,
+mixed-radix key build, virtual-column arithmetic, half-plane decomposition)
+into one pass over VMEM-resident row chunks.
+
+Exact int64 sums via fixed-point half-planes
+--------------------------------------------
+The MXU has no integer matmul wide enough for longSum semantics, and f32
+accumulation is only exact below 2^24. Each int32 aggregate input v >= 0 is
+decomposed into 4-bit planes  v = sum_j h_j * 16^j  (h_j in [0, 15], exact
+in bf16). Per grid step the kernel computes
+
+    partial[K, H] = onehotT[K, RB] . valsT[H, RB]^T      (bf16 x bf16 -> f32)
+
+whose entries are integer-valued and bounded by RB * 15 < 2^24, so the f32
+result is exact; it is then cast to int32 and accumulated across grid steps
+in the int32 output, exact while N_rows_per_chip * 15 < 2^31 (~143M rows —
+an eligibility condition). Host-side, planes recombine in int64:
+sum_j out[:, j] << 4j. Counts ride the same matmul as columns of ones.
+
+Eligibility (checked by `eligible()`, anything else falls back to the XLA
+scatter path — mirroring the planner's structural-fallback rule, SURVEY.md
+§2 property 2): granularity "all", no interval mask, dims lowered to
+codes/numeric-offset/remap (compare + small-table gather only), aggs are
+count / non-negative integer sums whose value bounds fit int32 (interval
+arithmetic over virtual-column exprs), no DOUBLE inputs, no float literals.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from tpu_olap.ir import aggregations as A
+from tpu_olap.ir import filters as F
+from tpu_olap.ir.expr import BinOp, Col, Lit
+from tpu_olap.kernels.exprs import eval_expr
+from tpu_olap.segments.segment import ColumnType, TIME_COLUMN
+
+N_PLANE_BITS = 4
+PLANE_MASK = (1 << N_PLANE_BITS) - 1
+MAX_VALUE = (1 << 31) - 1           # aggregate inputs must fit int32
+MAX_ROWS = MAX_VALUE // PLANE_MASK  # int32 accumulator headroom per chip
+
+
+def expr_int_bounds(expr, col_bounds):
+    """Conservative integer interval of an expression, or None if unbounded
+    / non-integer (division, functions, unknown columns) — or if ANY
+    intermediate result can leave int32 (the kernel evaluates the whole
+    tree in int32, so every node must fit, not just the root)."""
+    def fits(b):
+        return b if (b is not None and -MAX_VALUE <= b[0]
+                     and b[1] <= MAX_VALUE) else None
+
+    if isinstance(expr, Lit):
+        v = expr.value
+        if isinstance(v, (int, np.integer)) and not isinstance(v, bool):
+            return fits((int(v), int(v)))
+        return None
+    if isinstance(expr, Col):
+        return fits(col_bounds.get(expr.name))
+    if isinstance(expr, BinOp) and expr.op in ("+", "-", "*"):
+        a = expr_int_bounds(expr.left, col_bounds)
+        b = expr_int_bounds(expr.right, col_bounds)
+        if a is None or b is None:
+            return None
+        if expr.op == "+":
+            return fits((a[0] + b[0], a[1] + b[1]))
+        if expr.op == "-":
+            return fits((a[0] - b[1], a[1] - b[0]))
+        prods = [x * y for x in a for y in b]
+        return fits((min(prods), max(prods)))
+    return None
+
+
+class _Ineligible(Exception):
+    pass
+
+
+def column_bounds(plan, table) -> dict:
+    """Integer [min, max] of every numeric column the plan reads; raises
+    _Ineligible for DOUBLE columns or ranges that cannot load as int32.
+    Memoized on the plan — eligible() and build_kernel() share one scan."""
+    cached = getattr(plan, "_pallas_col_bounds", None)
+    if cached is not None:
+        return cached
+    md = table.column_metadata(set(plan.columns) or None)
+    bounds = {}
+    for c in plan.columns:
+        typ = table.schema[c]
+        if typ is ColumnType.STRING:
+            continue
+        if typ is ColumnType.DOUBLE:
+            raise _Ineligible(f"DOUBLE column {c!r}")
+        m = md.get(c, {})
+        if m.get("min") is None:
+            bounds[c] = (0, 0)  # empty table
+        else:
+            lo, hi = int(m["min"]), int(m["max"])
+            if lo < -MAX_VALUE or hi > MAX_VALUE:
+                raise _Ineligible(f"column {c!r} range exceeds int32")
+            bounds[c] = (lo, hi)
+    plan._pallas_col_bounds = bounds
+    return bounds
+
+
+def sum_bounds(plan, table) -> dict:
+    """Per-sum-aggregation input bounds (post eligibility: always bounded)."""
+    bounds = column_bounds(plan, table)
+    out = {}
+    for p in plan.agg_plans:
+        if p.kind != "sum":
+            continue
+        f = p.fields[0]
+        b = (expr_int_bounds(plan.virtual_exprs[f], bounds)
+             if f in plan.virtual_exprs else bounds.get(f))
+        out[p.name] = b
+    return out
+
+
+_SIMPLE_FILTERS = (F.SelectorFilter, F.BoundFilter, F.InFilter,
+                   F.RegexFilter, F.LikeFilter)
+
+
+def _filter_ok(spec) -> bool:
+    if spec is None or isinstance(spec, _SIMPLE_FILTERS):
+        return True
+    if isinstance(spec, (F.AndFilter, F.OrFilter)):
+        return all(_filter_ok(f) for f in spec.fields)
+    if isinstance(spec, F.NotFilter):
+        return _filter_ok(spec.field)
+    return False
+
+
+@dataclass
+class PallasLayout:
+    """Half-plane column layout of the [K, H] accumulator."""
+    n_cols: int                   # H (before lane padding)
+    rows_slot: int                # column index of the _rows count
+    agg_slots: tuple              # per agg: (name, kind, start, n_planes,
+    #                               bias) — bias < 0 means inputs are
+    #                               shifted by -bias into [0, hi-lo] and an
+    #                               extra per-agg row-count column sits at
+    #                               start + n_planes for the un-shift
+
+
+def plan_layout(agg_plans, sum_bounds) -> PallasLayout:
+    slots = []
+    h = 1  # slot 0: _rows
+    for p in agg_plans:
+        if p.kind == "count":
+            slots.append((p.name, "count", h, 1, 0))
+            h += 1
+        else:  # sum
+            n = -(-32 // N_PLANE_BITS)
+            lo = sum_bounds[p.name][0]
+            bias = lo if lo < 0 else 0
+            slots.append((p.name, "sum", h, n, bias))
+            h += n + (1 if bias else 0)
+    return PallasLayout(h, 0, tuple(slots))
+
+
+def eligible(query, plan, table, config) -> str | None:
+    """None if the plan can run on the Pallas kernel, else the reason."""
+    if plan.kind != "agg":
+        return "not an aggregate plan"
+    if plan.bucket_plan.kind != "all":
+        return "granularity is not 'all'"
+    if TIME_COLUMN in plan.columns:
+        return "needs the time column (interval mask)"
+    if plan.total_groups > config.pallas_group_cap:
+        return (f"group space {plan.total_groups} exceeds pallas cap "
+                f"{config.pallas_group_cap}")
+    if table.block_rows % 128 != 0:
+        return f"block_rows {table.block_rows} not a multiple of 128"
+    rb = min(table.block_rows, config.pallas_rows_per_block)
+    if table.block_rows % rb != 0:
+        return (f"pallas_rows_per_block {rb} does not divide block_rows "
+                f"{table.block_rows}")
+    if table.num_rows > MAX_ROWS:
+        return f"row count {table.num_rows} exceeds int32 headroom"
+    for dp in plan.dim_plans:
+        if dp.kind not in ("codes", "numeric", "remap"):
+            return f"dimension kind {dp.kind!r}"
+    if not _filter_ok(query.filter):
+        return "filter tree has non-simple members"
+
+    try:
+        bounds = column_bounds(plan, table)
+    except _Ineligible as e:
+        return str(e)
+
+    specs = {a.name: a for a in query.aggregations}
+
+    def base_spec(spec):
+        if isinstance(spec, A.FilteredAggregation):
+            if not _filter_ok(spec.filter):
+                return None
+            return base_spec(spec.aggregator)
+        return spec
+
+    for p in plan.agg_plans:
+        spec = base_spec(specs[p.name])
+        if spec is None:
+            return f"aggregator {p.name!r} has a non-simple filter"
+        if p.kind == "count":
+            continue
+        if p.kind != "sum":
+            return f"aggregation kind {p.kind!r}"
+        if np.dtype(p.acc_dtype).kind != "i":
+            return f"non-integer sum {p.name!r}"
+        f = p.fields[0]
+        if f in plan.virtual_exprs:
+            b = expr_int_bounds(plan.virtual_exprs[f], bounds)
+        else:
+            b = bounds.get(f)
+        if b is None:
+            return f"cannot bound sum input {f!r}"
+        if b[1] - b[0] > MAX_VALUE:
+            return f"sum input {f!r} span {b} exceeds int32"
+
+    for name, v in plan.pool.consts.items():
+        if v.dtype.kind == "f":
+            return f"float literal const {name}"
+        if v.dtype.kind == "i" and v.size and (
+                v.min() < -MAX_VALUE or v.max() > MAX_VALUE):
+            return f"const {name} exceeds int32"
+    return None
+
+
+def build_kernel(plan, table, config, filter_fn, interpret: bool):
+    """The Pallas replacement for lowering's generic agg kernel closure.
+
+    Same contract: fn(env, valid, seg_mask, consts) -> partial dict with
+    "_rows" plus one int64 [K] array per aggregation.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    layout = plan_layout(plan.agg_plans, sum_bounds(plan, table))
+    K = plan.total_groups
+    H = layout.n_cols
+    H_pad = max(128, -(-H // 128) * 128)
+    sizes = plan.sizes
+    dim_plans = plan.dim_plans
+    agg_plans = plan.agg_plans
+    vexprs = plan.virtual_exprs
+    block_rows = table.block_rows
+    rb = min(block_rows, config.pallas_rows_per_block)
+
+    const_names = sorted(plan.pool.consts)
+    col_names = list(plan.columns)
+
+    def make_kernel_fn(null_names):
+        def kernel_fn(*refs):
+            (col_refs, null_refs, valid_ref, const_refs,
+             out_ref) = _split_refs(refs, len(col_names), len(null_names),
+                                    len(const_names))
+            step = pl.program_id(0)
+
+            env = {"cols": {}, "nulls": {}}
+            for name, r in zip(col_names, col_refs):
+                env["cols"][name] = r[0, :]
+            for name, r in zip(null_names, null_refs):
+                env["nulls"][name] = r[0, :]
+            for name, ex in vexprs.items():
+                env["cols"][name] = eval_expr(ex, env["cols"], jnp)
+            consts = {n: r[0, :] for n, r in zip(const_names, const_refs)}
+
+            mask = valid_ref[0, :]
+            if filter_fn is not None:
+                mask = mask & filter_fn(env, consts)
+
+            # mixed-radix dense group key [rb]
+            key = None
+            for dp, size in zip(dim_plans, sizes[1:]):
+                i = dp.ids(env, consts, jnp).astype(jnp.int32)
+                key = i if key is None else key * jnp.int32(size) + i
+            if key is None:
+                key = jnp.zeros((rb,), jnp.int32)
+
+            # transposed masked one-hot [K, rb] — built directly in K-major
+            # orientation so every op stays 2-D (no big relayouts)
+            kk = jax.lax.broadcasted_iota(jnp.int32, (K, rb), 0)
+            onehot = ((kk == key[None, :]) & mask[None, :]).astype(jnp.bfloat16)
+
+            # value planes [H_pad, rb]
+            rows = [mask.astype(jnp.bfloat16)[None, :]]
+            for p, (name, kind, start, n_planes, bias) in zip(
+                    agg_plans, layout.agg_slots):
+                m = mask if p.filter_fn is None else \
+                    (mask & p.filter_fn(env, consts))
+                if kind == "count":
+                    rows.append(m.astype(jnp.bfloat16)[None, :])
+                    continue
+                f = p.fields[0]
+                v = env["cols"][f].astype(jnp.int32)
+                nm = env["nulls"].get(f)
+                if nm is not None:
+                    m = m & ~nm
+                if bias:
+                    v = v - jnp.int32(bias)  # shift into [0, hi-lo]
+                v = jnp.where(m, v, 0)
+                for j in range(n_planes):
+                    h = (v >> (N_PLANE_BITS * j)) & PLANE_MASK
+                    rows.append(h.astype(jnp.bfloat16)[None, :])
+                if bias:  # per-agg masked row count for the un-shift
+                    rows.append(m.astype(jnp.bfloat16)[None, :])
+            pad = H_pad - len(rows)
+            if pad:
+                rows.append(jnp.zeros((pad, rb), jnp.bfloat16))
+            vals = jnp.concatenate(rows, axis=0)
+
+            partial = jax.lax.dot_general(
+                onehot, vals, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(jnp.int32)
+
+            @pl.when(step == 0)
+            def _():
+                out_ref[:, :] = jnp.zeros((K, H_pad), jnp.int32)
+            out_ref[:, :] += partial
+        return kernel_fn
+
+    def row_spec():
+        return pl.BlockSpec((1, rb), lambda i: (0, i))
+
+    def const_spec(n):
+        return pl.BlockSpec((1, n), lambda i: (0, 0))
+
+    def fn(env, valid, seg_mask, consts):
+        n_segments = valid.shape[0]
+        n = n_segments * block_rows
+        grid = n // rb
+        null_names = sorted(env["nulls"])
+        mask2 = (valid & seg_mask[:, None]).reshape(1, n)
+        col_in = [_narrow(env["cols"][c].reshape(1, n), jnp)
+                  for c in col_names]
+        null_in = [env["nulls"][c].reshape(1, n) for c in null_names]
+        const_in = [_narrow(jnp.asarray(consts[c]).reshape(1, -1), jnp)
+                    for c in const_names]
+
+        out = pl.pallas_call(
+            make_kernel_fn(null_names),
+            grid=(grid,),
+            in_specs=([row_spec() for _ in col_in]
+                      + [row_spec() for _ in null_in]
+                      + [row_spec()]
+                      + [const_spec(c.shape[1]) for c in const_in]),
+            out_specs=pl.BlockSpec((K, H_pad), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((K, H_pad), jnp.int32),
+            interpret=interpret,
+        )(*col_in, *null_in, mask2, *const_in)
+
+        res = {"_rows": out[:, layout.rows_slot].astype(jnp.int64)}
+        for p, (name, kind, start, n_planes, bias) in zip(agg_plans,
+                                                          layout.agg_slots):
+            if kind == "count":
+                res[name] = out[:, start].astype(p.acc_dtype)
+            else:
+                acc = jnp.zeros((K,), jnp.int64)
+                for j in range(n_planes):
+                    acc = acc + (out[:, start + j].astype(jnp.int64)
+                                 << (N_PLANE_BITS * j))
+                if bias:
+                    n_masked = out[:, start + n_planes].astype(jnp.int64)
+                    acc = acc + jnp.int64(bias) * n_masked
+                res[name] = acc.astype(p.acc_dtype)
+        return res
+
+    return fn
+
+
+def _split_refs(refs, n_cols, n_nulls, n_consts):
+    refs = list(refs)
+    cols = refs[:n_cols]
+    nulls = refs[n_cols:n_cols + n_nulls]
+    valid = refs[n_cols + n_nulls]
+    consts = refs[n_cols + n_nulls + 1:n_cols + n_nulls + 1 + n_consts]
+    out = refs[-1]
+    return cols, nulls, valid, consts, out
+
+
+def _narrow(x, jnp):
+    """i64 -> i32 (eligibility guarantees the values fit); bool stays."""
+    if x.dtype == jnp.int64:
+        return x.astype(jnp.int32)
+    if x.dtype == jnp.float64:  # pragma: no cover — eligibility rejects
+        return x.astype(jnp.float32)
+    return x
