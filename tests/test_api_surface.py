@@ -1,0 +1,119 @@
+"""L7 surface tests: statement verbs, counters, and the HTTP query server
+(the ThriftServer-wrapper analog, SURVEY.md §3.1/§4.5)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpu_olap import Engine
+from tpu_olap.api.server import QueryServer
+
+
+@pytest.fixture()
+def engine():
+    rng = np.random.default_rng(5)
+    n = 5000
+    df = pd.DataFrame({
+        "ts": pd.to_datetime("2021-06-01")
+        + pd.to_timedelta(rng.integers(0, 86400 * 60, n), unit="s"),
+        "shop": rng.choice(["a", "b", "c"], n),
+        "amount": rng.integers(1, 500, n).astype(np.int64),
+    })
+    eng = Engine()
+    eng.register_table("sales", df, time_column="ts")
+    return eng
+
+
+def test_clear_cache_verb(engine):
+    engine.sql("SELECT shop, sum(amount) AS s FROM sales GROUP BY shop")
+    assert engine.runner._datasets
+    out = engine.sql("CLEAR DRUID CACHE")
+    assert out.status[0] == "cleared cache"
+    assert not engine.runner._datasets
+    out = engine.sql("CLEAR DRUID CACHE sales")
+    assert "sales" in out.status[0]
+
+
+def test_explain_rewrite_verb(engine):
+    out = engine.sql(
+        "EXPLAIN DRUID REWRITE SELECT shop, sum(amount) AS s "
+        "FROM sales GROUP BY shop")
+    text = "\n".join(out.plan)
+    info = json.loads(text)
+    assert info["rewritten"] is True
+    assert info["query"]["queryType"] == "groupBy"
+
+
+def test_passthrough_verb(engine):
+    spec = json.dumps({
+        "queryType": "timeseries",
+        "granularity": "all",
+        "aggregations": [{"type": "longSum", "name": "s",
+                          "fieldName": "amount"}],
+    })
+    out = engine.sql(
+        f"ON DRUID DATASOURCE sales EXECUTE QUERY '{spec}'")
+    ref = engine.sql("SELECT sum(amount) AS s FROM sales")
+    assert int(out.s[0]) == int(ref.s[0])
+
+
+def test_counters(engine):
+    engine.sql("SELECT shop, sum(amount) AS s FROM sales GROUP BY shop")
+    engine.sql("SELECT sum(amount) AS s FROM sales")
+    c = engine.counters()
+    assert c["queries"] == 2
+    assert c["rows_scanned"] > 0
+    assert c["by_query_type"] == {"groupBy": 1, "timeseries": 1}
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def test_http_server(engine):
+    srv = QueryServer(engine).start()
+    try:
+        out = _post(srv.url + "/sql", {
+            "query": "SELECT shop, sum(amount) AS s FROM sales "
+                     "GROUP BY shop ORDER BY shop"})
+        assert out["columns"] == ["shop", "s"]
+        assert [r["shop"] for r in out["rows"]] == ["a", "b", "c"]
+
+        druid = _post(srv.url + "/druid/v2", {
+            "queryType": "timeseries",
+            "dataSource": "sales",
+            "granularity": "all",
+            "aggregations": [{"type": "longSum", "name": "s",
+                              "fieldName": "amount"}]})
+        assert druid[0]["result"]["s"] == sum(r["s"] for r in out["rows"])
+
+        status = _get(srv.url + "/status")
+        assert status["tables"]["sales"]["accelerated"] is True
+        assert status["counters"]["queries"] >= 2
+
+        meta = _get(srv.url + "/status/metadata/sales")
+        assert meta["columns"]["amount"]["type"] == "LONG"
+
+        # bad SQL -> 400 with an error body, server stays up
+        try:
+            _post(srv.url + "/sql", {"query": "SELEKT nope"})
+            raise AssertionError("expected HTTPError")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "error" in json.loads(e.read())
+        out2 = _get(srv.url + "/status")
+        assert out2["engine"] == "tpu_olap"
+    finally:
+        srv.stop()

@@ -69,7 +69,16 @@ class Engine:
     # --------------------------------------------------------------- SQL
 
     def sql(self, query: str) -> pd.DataFrame:
-        """Plan, execute (device or fallback), and return a DataFrame."""
+        """Plan, execute (device or fallback), and return a DataFrame.
+
+        Statement-level verbs beyond SELECT (the reference's extended
+        parser, SURVEY.md §3.1): `CLEAR DRUID CACHE [table]`,
+        `EXPLAIN DRUID REWRITE <sql>`, and
+        `ON DRUID DATASOURCE <ds> EXECUTE QUERY '<json>'`.
+        """
+        verb = _match_verb(query)
+        if verb is not None:
+            return verb(self)
         plan = self.planner.plan(query)
         self.last_plan = plan
         if plan.rewritten:
@@ -139,3 +148,75 @@ class Engine:
     def history(self):
         """Per-query observability records (SURVEY.md §6 tracing)."""
         return self.runner.history
+
+    def counters(self) -> dict:
+        """Aggregate observability counters over the query history
+        (SURVEY.md §6 metrics: 'counters exported as a dict')."""
+        hist = self.runner.history
+        out = {
+            "queries": len(hist),
+            "rows_scanned": sum(h.get("rows_scanned", 0) for h in hist),
+            "segments_scanned": sum(h.get("segments_scanned", 0)
+                                    for h in hist),
+            "segments_pruned": sum(
+                h.get("segments_total", 0) - h.get("segments_scanned", 0)
+                for h in hist),
+            "cache_hits": sum(1 for h in hist if h.get("cache_hit")),
+            "total_ms": sum(h.get("total_ms", 0.0) for h in hist),
+        }
+        by_type: dict = {}
+        for h in hist:
+            by_type[h.get("query_type", "?")] = \
+                by_type.get(h.get("query_type", "?"), 0) + 1
+        out["by_query_type"] = by_type
+        return out
+
+
+# --------------------------------------------------------------------------
+# Statement-level verbs (the reference's SparklineDataParser additions)
+
+import json as _json
+import re as _re
+
+_CLEAR_RE = _re.compile(
+    r"^\s*clear\s+druid\s+cache(?:\s+(\w+))?\s*;?\s*$", _re.I)
+_EXPLAIN_RE = _re.compile(
+    r"^\s*explain\s+druid\s+rewrite\s+(.+?)\s*;?\s*$", _re.I | _re.S)
+_EXEC_RE = _re.compile(
+    r"^\s*on\s+druid\s+datasource\s+(\w+)\s+execute\s+query\s+"
+    r"'(.+)'\s*;?\s*$", _re.I | _re.S)
+
+
+def _match_verb(query: str):
+    m = _CLEAR_RE.match(query)
+    if m:
+        table = m.group(1)
+        return lambda eng: _run_clear(eng, table)
+    m = _EXPLAIN_RE.match(query)
+    if m:
+        inner = m.group(1)
+        return lambda eng: _run_explain(eng, inner)
+    m = _EXEC_RE.match(query)
+    if m:
+        ds, body = m.group(1), m.group(2).replace("''", "'")
+        return lambda eng: _run_passthrough(eng, ds, body)
+    return None
+
+
+def _run_clear(eng: Engine, table: str | None) -> pd.DataFrame:
+    eng.clear_cache(table)
+    return pd.DataFrame({"status": [
+        f"cleared cache for {table}" if table else "cleared cache"]})
+
+
+def _run_explain(eng: Engine, inner_sql: str) -> pd.DataFrame:
+    info = eng.explain(inner_sql)
+    lines = _json.dumps(info, indent=2, default=str).splitlines()
+    return pd.DataFrame({"plan": lines})
+
+
+def _run_passthrough(eng: Engine, datasource: str, body: str) -> pd.DataFrame:
+    spec = _json.loads(body)
+    spec.setdefault("dataSource", datasource)
+    res = eng.execute_ir(spec)
+    return res.to_pandas()

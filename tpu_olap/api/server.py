@@ -1,0 +1,138 @@
+"""HTTP query server — the BI-connectivity analog of the reference's
+ThriftServer wrapper (SURVEY.md §3.1: "Lets Tableau/BI tools hit
+accelerated tables over JDBC/ODBC").
+
+JDBC/ODBC is JVM plumbing with no TPU-native counterpart; the idiomatic
+equivalent is a JSON-over-HTTP surface (stdlib only, no new deps):
+
+  POST /sql          {"query": "SELECT ..."}      -> {columns, rows}
+                     (statement verbs work too: CLEAR DRUID CACHE, ...)
+  POST /druid/v2     native Druid query JSON      -> Druid-wire results
+                     (the raw-IR passthrough, SURVEY.md §4.5 — lets
+                     existing Druid clients talk to the TPU engine)
+  GET  /status       engine + per-table summary + counters
+  GET  /status/metadata/<table>  column metadata (segmentMetadata shape)
+
+Queries serialize through a lock: the engine's compile/arg caches are not
+concurrent, and a single TPU program queue is the execution model anyway
+(SURVEY.md §3.5 P1).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def _jsonable(x):
+    """Strict-JSON sanitizer: NaN/inf -> null (SQL-null semantics); BI
+    clients reject bare NaN/Infinity literals."""
+    if isinstance(x, float) and not math.isfinite(x):
+        return None
+    if isinstance(x, dict):
+        return {k: _jsonable(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_jsonable(v) for v in x]
+    return x
+
+
+class QueryServer:
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0):
+        self.engine = engine
+        self._lock = threading.Lock()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet; engine.history observes
+                pass
+
+            def _send(self, code: int, payload):
+                body = json.dumps(_jsonable(payload), default=str,
+                                  allow_nan=False).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length", 0))
+                return self.rfile.read(n).decode()
+
+            def do_GET(self):
+                try:
+                    self._send(200, server._get(self.path))
+                except KeyError as e:
+                    self._send(404, {"error": str(e)})
+                except Exception as e:
+                    self._send(500, {"error": str(e)})
+
+            def do_POST(self):
+                try:
+                    self._send(200, server._post(self.path, self._body()))
+                except (ValueError, KeyError) as e:
+                    self._send(400, {"error": str(e)})
+                except Exception as e:
+                    self._send(500, {"error": str(e)})
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self.httpd.server_address
+        self._thread = None
+
+    # ------------------------------------------------------------ control
+
+    def start(self):
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # ----------------------------------------------------------- handlers
+
+    def _get(self, path: str):
+        if path == "/status":
+            eng = self.engine
+            return {
+                "engine": "tpu_olap",
+                "tables": {name: {
+                    "accelerated": e.is_accelerated,
+                    "numRows": (e.segments.num_rows if e.is_accelerated
+                                else len(e.frame)),
+                } for name, e in ((n, eng.catalog.get(n))
+                                  for n in eng.catalog.names())},
+                "counters": eng.counters(),
+            }
+        if path.startswith("/status/metadata/"):
+            name = path.rsplit("/", 1)[1]
+            entry = self.engine.catalog.get(name)
+            if not entry.is_accelerated:
+                return {"table": name, "accelerated": False}
+            return {"table": name,
+                    "columns": entry.segments.column_metadata()}
+        raise KeyError(f"unknown path {path!r}")
+
+    def _post(self, path: str, body: str):
+        if path == "/sql":
+            req = json.loads(body)
+            with self._lock:
+                frame = self.engine.sql(req["query"])
+            return {"columns": list(frame.columns),
+                    "rows": frame.to_dict("records")}
+        if path in ("/druid/v2", "/druid/v2/"):
+            spec = json.loads(body)
+            with self._lock:
+                res = self.engine.execute_ir(spec)
+            return res.druid
+        raise KeyError(f"unknown path {path!r}")

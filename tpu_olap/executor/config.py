@@ -61,6 +61,16 @@ class EngineConfig:
     cost_model_enabled: bool = True
     shard_merge_factor: float = 1.0
 
+    # failure detection / elastic recovery (SURVEY.md §6): device dispatch
+    # retries after purging device caches; with a mesh, repeated failure
+    # halves the shard count (the "chip loss -> re-shard the manifest"
+    # analog of the reference's Spark task retry over DruidRDD partitions).
+    dispatch_retries: int = 1
+    degrade_shards_on_retry: bool = False
+    # test hook: callable(stage: str, attempt: int) -> None, may raise to
+    # inject a dispatch fault (None in production)
+    fault_injector: object = None
+
     # Pallas fused one-hot MXU reduce (kernels.pallas_reduce): "auto" uses
     # it on the TPU backend for eligible plans, "force" uses it everywhere
     # eligible (interpret mode off-TPU — for tests), "never" disables.

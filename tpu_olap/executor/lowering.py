@@ -254,15 +254,17 @@ def _maybe_use_pallas(plan, query, table, config, filter_fn):
             f"{config.use_pallas!r}")
     if config.use_pallas == "never" or config.platform == "cpu":
         return
+    # cheap backend gate first: under "auto" off-TPU, skip the eligibility
+    # scan entirely (it reads per-column min/max metadata)
+    on_tpu = _default_backend() == "tpu"
+    if config.use_pallas == "auto" and not on_tpu:
+        plan.pallas_reason = "auto: backend is not tpu"
+        return
     from tpu_olap.kernels import pallas_reduce
 
     reason = pallas_reduce.eligible(query, plan, table, config)
     if reason is not None:
         plan.pallas_reason = reason
-        return
-    on_tpu = _default_backend() == "tpu"
-    if config.use_pallas == "auto" and not on_tpu:
-        plan.pallas_reason = "auto: backend is not tpu"
         return
     plan.kernel = pallas_reduce.build_kernel(plan, table, config, filter_fn,
                                              interpret=not on_tpu)

@@ -57,15 +57,43 @@ class QueryRunner:
         self._arg_cache: dict = {}   # uploaded consts/seg-mask, content-keyed
         self._cap_hints: dict = {}   # template -> last observed group count
         self._mesh = None
+        self._active_shards = config.num_shards if config else None
         self.history: list = []
 
     @property
     def mesh(self):
         if self._mesh is None and self.config.platform != "cpu" and \
-                (self.config.num_shards or 1) > 1:
+                (self._active_shards or 1) > 1:
             from tpu_olap.executor.sharding import make_mesh
-            self._mesh = make_mesh(self.config.num_shards)
+            self._mesh = make_mesh(self._active_shards)
         return self._mesh
+
+    def _dispatch(self, call, metrics: dict, table_name: str):
+        """Run a device dispatch with retry-based recovery (SURVEY.md §6
+        failure detection): on failure, purge the query's table-scoped
+        device state (its buffers/programs could be poisoned by a device
+        reset — other tables' warm caches are left alone) and re-run;
+        with degrade_shards_on_retry, halve the mesh — the in-process
+        analog of re-sharding the segment manifest after chip loss."""
+        attempts = max(1, self.config.dispatch_retries + 1)
+        for attempt in range(attempts):
+            try:
+                if self.config.fault_injector is not None:
+                    self.config.fault_injector("dispatch", attempt)
+                return call()
+            except Exception:
+                if attempt + 1 >= attempts:
+                    raise
+                metrics["retries"] = attempt + 1
+                if self.config.degrade_shards_on_retry and \
+                        (self._active_shards or 1) > 1:
+                    # mesh shrink invalidates every table's shardings
+                    self.clear_cache()
+                    self._mesh = None
+                    self._active_shards = max(1, self._active_shards // 2)
+                    metrics["degraded_shards"] = self._active_shards
+                else:
+                    self.clear_cache(table_name)
 
     # ------------------------------------------------------------------ API
 
@@ -273,7 +301,9 @@ class QueryRunner:
 
         packed = None
         if self.config.platform != "cpu":
-            packed = self._run_packed(plan, metrics)
+            packed = self._dispatch(
+                lambda: self._run_packed(plan, metrics), metrics,
+                table.name)
         if packed is not None:
             idx, compact, layout = packed
             for p in plan.agg_plans:
@@ -285,7 +315,9 @@ class QueryRunner:
         else:
             if self.config.platform != "cpu":
                 metrics["packed"] = False  # cap overflow: unpacked re-run
-            partials = self._run_partials(plan, metrics)
+            partials = self._dispatch(
+                lambda: self._run_partials(plan, metrics), metrics,
+                table.name)
             t0 = time.perf_counter()
             arrays = finalize_aggs(partials, plan.agg_plans, specs)
         eval_post_aggs(arrays, query.post_aggregations)
@@ -431,7 +463,8 @@ class QueryRunner:
         t0 = time.perf_counter()
         plan = lower(query, table, self.config)
         metrics["lower_ms"] = (time.perf_counter() - t0) * 1000
-        partials = self._run_partials(plan, metrics)
+        partials = self._dispatch(
+            lambda: self._run_partials(plan, metrics), metrics, table.name)
         mask = partials["mask"].reshape(-1, table.block_rows)
         mask = mask[:len(table.segments)]  # drop shard-padding segments
 

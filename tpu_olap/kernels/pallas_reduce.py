@@ -86,9 +86,16 @@ class _Ineligible(Exception):
 def column_bounds(plan, table) -> dict:
     """Integer [min, max] of every numeric column the plan reads; raises
     _Ineligible for DOUBLE columns or ranges that cannot load as int32.
-    Memoized on the plan — eligible() and build_kernel() share one scan."""
-    cached = getattr(plan, "_pallas_col_bounds", None)
+    Memoized on the table (segments are immutable after ingest), so
+    repeated queries over the same columns pay the metadata scan once."""
+    cache = getattr(table, "_pallas_bounds_cache", None)
+    if cache is None:
+        cache = table._pallas_bounds_cache = {}
+    key = plan.columns
+    cached = cache.get(key)
     if cached is not None:
+        if isinstance(cached, _Ineligible):
+            raise cached
         return cached
     md = table.column_metadata(set(plan.columns) or None)
     bounds = {}
@@ -97,16 +104,20 @@ def column_bounds(plan, table) -> dict:
         if typ is ColumnType.STRING:
             continue
         if typ is ColumnType.DOUBLE:
-            raise _Ineligible(f"DOUBLE column {c!r}")
+            err = _Ineligible(f"DOUBLE column {c!r}")
+            cache[key] = err
+            raise err
         m = md.get(c, {})
         if m.get("min") is None:
             bounds[c] = (0, 0)  # empty table
         else:
             lo, hi = int(m["min"]), int(m["max"])
             if lo < -MAX_VALUE or hi > MAX_VALUE:
-                raise _Ineligible(f"column {c!r} range exceeds int32")
+                err = _Ineligible(f"column {c!r} range exceeds int32")
+                cache[key] = err
+                raise err
             bounds[c] = (lo, hi)
-    plan._pallas_col_bounds = bounds
+    cache[key] = bounds
     return bounds
 
 
