@@ -1,0 +1,108 @@
+"""Timezone-aware granularity EXECUTION tests (SURVEY.md §5: "date-time
+function tests (granularity/extraction correctness incl. timezone)").
+
+test_timeutil pins boundary math; these run full queries through the
+engine across DST transitions and compare against pandas tz-aware
+truncation — the semantics Druid defines for period granularities with a
+time zone (local calendar buckets, offset changes at DST).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpu_olap import Engine
+from tpu_olap.executor import EngineConfig
+from tpu_olap.ir.aggregations import CountAggregation, SumAggregation
+from tpu_olap.ir.granularity import PeriodGranularity
+from tpu_olap.ir.query import TimeseriesQuerySpec
+
+NY = "America/New_York"
+
+
+@pytest.fixture(scope="module")
+def engine():
+    rng = np.random.default_rng(17)
+    # one row every 20 minutes across the 2021 US spring-forward (Mar 14)
+    # and fall-back (Nov 7) transitions
+    ts = pd.date_range("2021-03-12", "2021-03-17", freq="20min",
+                       tz="UTC").tz_localize(None)
+    ts = ts.append(pd.date_range("2021-11-05", "2021-11-10", freq="20min",
+                                 tz="UTC").tz_localize(None))
+    df = pd.DataFrame({
+        "ts": ts,
+        "v": rng.integers(1, 100, len(ts)).astype(np.int64),
+    })
+    eng = Engine(EngineConfig())
+    eng.register_table("e", df, time_column="ts", block_rows=256)
+    eng._test_frame = df
+    return eng
+
+
+def _run_timeseries(eng, period, tz):
+    q = TimeseriesQuerySpec(
+        data_source="e",
+        granularity=PeriodGranularity(period, tz),
+        aggregations=(CountAggregation("n"), SumAggregation("s", "v")),
+    )
+    res = eng.execute_ir(q)
+    return res.rows
+
+
+@pytest.mark.parametrize("tz", ["UTC", NY])
+def test_day_buckets_across_dst(engine, tz):
+    rows = _run_timeseries(engine, "P1D", tz)
+    df = engine._test_frame
+    loc = df.set_index("ts").tz_localize("UTC").tz_convert(tz)
+    exp = loc.groupby(loc.index.normalize()).agg(
+        n=("v", "size"), s=("v", "sum"))
+    got = {r["timestamp"]: (r["n"], r["s"]) for r in rows if r["n"] > 0}
+    assert len(got) == len(exp)
+    for ts_local, row in exp.iterrows():
+        iso = ts_local.tz_convert("UTC").tz_localize(None) \
+            .isoformat(timespec="milliseconds") + "Z"
+        assert got[iso] == (row.n, row.s), (tz, iso)
+
+
+def test_dst_spring_forward_day_is_23_hours(engine):
+    """The Mar 14 2021 NY bucket spans 23 real hours; hour buckets inside
+    it must still partition the rows exactly."""
+    day_rows = _run_timeseries(engine, "P1D", NY)
+    hour_rows = _run_timeseries(engine, "PT1H", NY)
+    # locate the spring-forward local day: starts 2021-03-14T05:00Z
+    target = "2021-03-14T05:00:00.000Z"
+    day = next(r for r in day_rows if r["timestamp"] == target)
+    nxt = "2021-03-15T04:00:00.000Z"  # next local midnight is EDT (UTC-4)
+    in_day = [r for r in hour_rows if target <= r["timestamp"] < nxt]
+    assert sum(r["n"] for r in in_day) == day["n"]
+    assert sum(r["s"] for r in in_day) == day["s"]
+    assert len([r for r in in_day if r["n"] > 0]) == 23  # 23-hour day
+
+
+def test_fall_back_day_is_25_hours(engine):
+    day_rows = _run_timeseries(engine, "P1D", NY)
+    target = "2021-11-07T04:00:00.000Z"  # local midnight EDT (UTC-4)
+    nxt = "2021-11-08T05:00:00.000Z"     # next local midnight EST (UTC-5)
+    hour_rows = _run_timeseries(engine, "PT1H", NY)
+    day = next(r for r in day_rows if r["timestamp"] == target)
+    in_day = [r for r in hour_rows if target <= r["timestamp"] < nxt]
+    assert sum(r["n"] for r in in_day) == day["n"]
+    assert len([r for r in in_day if r["n"] > 0]) == 25  # 25-hour day
+
+
+def test_sql_date_trunc_tz_parity_utc(engine):
+    """SQL surface: date_trunc over the DST data stays on the device path
+    and matches the pandas fallback exactly."""
+    from tpu_olap.bench.parity import check_query
+    check_query(engine, "SELECT date_trunc('day', ts) AS d, count(*) AS n, "
+                        "sum(v) AS s FROM e GROUP BY date_trunc('day', ts)")
+
+
+def test_month_granularity_tz(engine):
+    rows = _run_timeseries(engine, "P1M", NY)
+    df = engine._test_frame
+    loc = df.set_index("ts").tz_localize("UTC").tz_convert(NY)
+    exp = loc.groupby([loc.index.year, loc.index.month]).agg(s=("v", "sum"))
+    present = [r for r in rows if r["n"] > 0]
+    assert len(present) == len(exp)
+    assert sorted(r["s"] for r in present) == sorted(int(x) for x in exp.s)

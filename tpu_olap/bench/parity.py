@@ -105,8 +105,10 @@ def assert_frame_parity(a: pd.DataFrame, b: pd.DataFrame,
                     .equals(pd.to_datetime(bv).reset_index(drop=True))):
                 raise ParityError(f"{tag}datetime column {c!r} mismatch")
             continue
-        xa = av.where(pd.notna(av), None).tolist()
-        xb = bv.where(pd.notna(bv), None).tolist()
+        # NOT Series.where(cond, None): pandas treats other=None as "use
+        # the default fill" (NaN), so nulls would survive and nan != nan
+        xa = [None if pd.isna(v) else v for v in av]
+        xb = [None if pd.isna(v) else v for v in bv]
         for i, (va, vb) in enumerate(zip(xa, xb)):
             if va != vb:
                 raise ParityError(

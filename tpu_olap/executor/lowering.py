@@ -20,7 +20,7 @@ from tpu_olap.ir.interval import ETERNITY
 from tpu_olap.ir.query import (GroupByQuerySpec, ScanQuerySpec,
                                SearchQuerySpec, SelectQuerySpec,
                                TimeseriesQuerySpec, TopNQuerySpec)
-from tpu_olap.kernels.exprs import eval_expr
+from tpu_olap.kernels.exprs import materialize_virtuals
 from tpu_olap.kernels.filtereval import ConstPool, compile_filter
 from tpu_olap.kernels.groupby import (UnsupportedAggregation,
                                       build_group_key, compile_aggregations,
@@ -207,8 +207,7 @@ def _lower_agg(query, table, config) -> PhysicalPlan:
         xp = np if isinstance(valid, np.ndarray) else _jnp()
         flat = {c: a.reshape(-1) for c, a in env["cols"].items()}
         nulls = {c: a.reshape(-1) for c, a in env["nulls"].items()}
-        for name, ex in vexprs.items():
-            flat[name] = eval_expr(ex, flat, xp)
+        materialize_virtuals(vexprs, flat, nulls, xp)
         fenv = {"cols": flat, "nulls": nulls}
         mask = (valid & seg_mask[:, None]).reshape(-1)
         if filter_fn is not None:
@@ -305,8 +304,7 @@ def _lower_mask(query, table, config) -> PhysicalPlan:
         xp = np if isinstance(valid, np.ndarray) else _jnp()
         flat = {c: a.reshape(-1) for c, a in env["cols"].items()}
         nulls = {c: a.reshape(-1) for c, a in env["nulls"].items()}
-        for name, ex in vexprs.items():
-            flat[name] = eval_expr(ex, flat, xp)
+        materialize_virtuals(vexprs, flat, nulls, xp)
         fenv = {"cols": flat, "nulls": nulls}
         mask = (valid & seg_mask[:, None]).reshape(-1)
         if filter_fn is not None:

@@ -52,6 +52,29 @@ def eval_expr(expr: Expr, env: dict, xp):
     raise TypeError(f"not an expression: {expr!r}")
 
 
+def virtual_null_mask(expr: Expr, nulls: dict, xp):
+    """SQL null propagation for virtual columns: the result is null where
+    ANY referenced input is null. Returns a bool mask or None when no
+    referenced column carries nulls."""
+    mask = None
+    for col in expr.columns():
+        m = nulls.get(col)
+        if m is not None:
+            mask = m if mask is None else (mask | m)
+    return mask
+
+
+def materialize_virtuals(vexprs: dict, cols: dict, nulls: dict, xp) -> None:
+    """Evaluate every virtual column into `cols` AND attach its null mask
+    to `nulls` (SQL null propagation). The single shared site for all
+    kernels — forgetting the mask half reintroduces a null-leak bug."""
+    for name, ex in vexprs.items():
+        cols[name] = eval_expr(ex, cols, xp)
+        nm = virtual_null_mask(ex, nulls, xp)
+        if nm is not None:
+            nulls[name] = nm
+
+
 def _as_float(v, xp):
     from tpu_olap.kernels.hashing import has_x64
     if hasattr(v, "dtype") and v.dtype.kind in "iu":

@@ -40,7 +40,7 @@ import numpy as np
 from tpu_olap.ir import aggregations as A
 from tpu_olap.ir import filters as F
 from tpu_olap.ir.expr import BinOp, Col, Lit
-from tpu_olap.kernels.exprs import eval_expr
+from tpu_olap.kernels.exprs import materialize_virtuals
 from tpu_olap.segments.segment import ColumnType, TIME_COLUMN
 
 N_PLANE_BITS = 4
@@ -281,8 +281,7 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool):
                 env["cols"][name] = r[0, :]
             for name, r in zip(null_names, null_refs):
                 env["nulls"][name] = r[0, :]
-            for name, ex in vexprs.items():
-                env["cols"][name] = eval_expr(ex, env["cols"], jnp)
+            materialize_virtuals(vexprs, env["cols"], env["nulls"], jnp)
             consts = {n: r[0, :] for n, r in zip(const_names, const_refs)}
 
             mask = valid_ref[0, :]

@@ -120,15 +120,16 @@ def _aggregate(df, exprs, out_names, group_exprs, stmt, time_col):
             if e.name == "count" and not e.args:
                 return len(sub)
             if e.name == "count":
-                return _eval(e.args[0], sub, time_col).notna().sum()
+                return _eval_agg_input(e.args[0], sub, time_col) \
+                    .notna().sum()
             if e.name in ("count_distinct", "approx_count_distinct",
                           "theta_sketch"):
-                vals = [_eval(a, sub, time_col) for a in e.args]
+                vals = [_eval_agg_input(a, sub, time_col) for a in e.args]
                 if len(vals) == 1:
                     return vals[0].dropna().nunique()
                 tup = pd.concat(vals, axis=1).dropna()
                 return len(tup.drop_duplicates())
-            v = _eval(e.args[0], sub, time_col)
+            v = _eval_agg_input(e.args[0], sub, time_col)
             if e.name == "sum":
                 return v.sum()
             if e.name == "min":
@@ -264,6 +265,10 @@ def _eval(e, df, time_col):
         out = _APPLY[e.op](left, right)
         if e.op in ("==", "!=", "<", "<=", ">", ">=", "&&", "||") and \
                 hasattr(out, "fillna"):
+            # filter-context semantics: a comparison with a NULL operand is
+            # False at the leaf (matches the device's filtereval rule).
+            # Aggregation inputs instead mask whole-expression nulls via
+            # _expr_null_mask — matching kernels.exprs.virtual_null_mask.
             out = out.fillna(False).astype(bool)
         return out
     if isinstance(e, FuncCall):
@@ -305,6 +310,29 @@ def _eval(e, df, time_col):
             return _eval(e.args[0], df, time_col).abs()
         raise FallbackError(f"unknown function {fn!r}")
     raise FallbackError(f"cannot evaluate {e!r}")
+
+
+def _expr_null_mask(e, df, time_col):
+    """SQL null propagation for an expression used as an AGGREGATION
+    input: the value is null wherever any referenced column is null
+    (the fallback mirror of kernels.exprs.virtual_null_mask)."""
+    mask = None
+    for col in e.columns():
+        name = col.split(".")[-1]
+        if name in df.columns:
+            na = df[name].isna()
+            mask = na if mask is None else (mask | na)
+    return mask
+
+
+def _eval_agg_input(e, df, time_col):
+    """Evaluate an aggregation-input expression with whole-expression
+    null masking (NULL if any referenced input is NULL)."""
+    v = _eval(e, df, time_col)
+    mask = _expr_null_mask(e, df, time_col)
+    if mask is not None and hasattr(v, "mask") and mask.any():
+        v = v.mask(mask)
+    return v
 
 
 def _eval_bool(e, df, time_col):

@@ -28,7 +28,7 @@ AGG_FUNCS = {"count", "sum", "min", "max", "avg", "count_distinct",
 _TOKEN = re.compile(r"""
     \s*(?:
       (?P<num>\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)
-    | (?P<name>[A-Za-z_][A-Za-z0-9_]*(?:\.[A-Za-z_][A-Za-z0-9_]*)?)
+    | (?P<name>[^\W\d]\w*(?:\.[^\W\d]\w*)?)
     | (?P<str>'(?:[^']|'')*')
     | (?P<op><>|!=|<=|>=|=|<|>|\(|\)|,|\*|\+|-|/|%)
     )""", re.VERBOSE)
