@@ -75,8 +75,11 @@ def _gen_query(rng):
         if rng.random() < 0.3:
             sql += f" HAVING {aggs[0][1]} > 0"
     if rng.random() < 0.5 and group:
-        key = group[0] if group[0] in dims else aggs[0][1]
-        sql += f" ORDER BY {key} {'DESC' if rng.random() < 0.5 else 'ASC'}"
+        # order by EVERY group key so LIMIT selects a unique row set —
+        # ties under a partial ORDER BY may legally differ between paths
+        keys = [g if g in dims else "tg" for g in group]
+        direction = "DESC" if rng.random() < 0.5 else "ASC"
+        sql += " ORDER BY " + ", ".join(f"{k} {direction}" for k in keys)
         if rng.random() < 0.5:
             sql += f" LIMIT {int(rng.integers(1, 30))}"
     return sql

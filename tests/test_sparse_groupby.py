@@ -1,0 +1,125 @@
+"""Sort-based sparse group-by (kernels.sparse_groupby): high-cardinality
+GROUP BY beyond the dense mixed-radix budget (SURVEY.md §8.4 hard part #1).
+
+dense_group_budget is forced tiny so ordinary-size tables exercise the
+sparse path; parity versus the pandas fallback is the oracle throughout.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpu_olap import Engine
+from tpu_olap.bench.parity import check_query
+from tpu_olap.executor import EngineConfig
+from tpu_olap.executor.lowering import lower
+
+
+def _df(n=6000, seed=23):
+    rng = np.random.default_rng(seed)
+    df = pd.DataFrame({
+        "ts": pd.to_datetime("2022-01-01")
+        + pd.to_timedelta(rng.integers(0, 86400 * 100, n), unit="s"),
+        "a": rng.choice([f"a{i}" for i in range(150)], n),
+        "b": rng.choice([f"b{i}" for i in range(90)], n),
+        "c": rng.choice(["x", "y", None], n),
+        "v": rng.integers(-100, 1000, n).astype(np.int64),
+        "w": np.round(rng.random(n) * 50, 4),
+    })
+    df.loc[rng.random(n) < 0.03, "v"] = np.nan
+    df["v"] = df["v"].astype("Int64")
+    return df
+
+
+def _engine(**kw):
+    cfg = EngineConfig(dense_group_budget=64, **kw)
+    eng = Engine(cfg)
+    eng.register_table("t", _df(), time_column="ts", block_rows=512)
+    return eng
+
+
+SQL = ("SELECT a, b, sum(v) AS sv, count(*) AS n, min(w) AS mw, "
+       "max(v) AS xv FROM t GROUP BY a, b")
+
+
+def test_sparse_plan_selected():
+    eng = _engine()
+    plan = eng.planner.plan(SQL)
+    phys = lower(plan.query, plan.entry.segments, eng.config)
+    assert phys.sparse
+    assert phys.total_groups > 64
+
+
+def test_sparse_parity():
+    check_query(_engine(), SQL)
+
+
+def test_sparse_parity_with_filter_and_having():
+    check_query(_engine(),
+                "SELECT a, b, sum(v) AS sv, count(*) AS n FROM t "
+                "WHERE w < 40 AND c = 'x' GROUP BY a, b "
+                "HAVING count(*) > 1")
+
+
+def test_sparse_count_distinct():
+    check_query(_engine(),
+                "SELECT a, approx_count_distinct(b) AS d FROM t GROUP BY a",
+                approx_cols=("d",))
+
+
+def test_sparse_order_limit():
+    check_query(_engine(),
+                "SELECT a, b, sum(v) AS sv FROM t GROUP BY a, b "
+                "ORDER BY sv DESC LIMIT 17")
+
+
+def test_sparse_multichip_parity():
+    check_query(_engine(num_shards=8), SQL)
+
+
+def test_sparse_cap_adapts():
+    eng = _engine(sparse_group_cap=64)
+    res = eng.sql(SQL)
+    h = eng.history[-1]
+    assert h["sparse"] and h["result_groups"] > 64
+    assert h["result_cap"] >= h["result_groups"]
+    assert len(res) == h["result_groups"]
+
+
+def test_sparse_budget_exceeded_falls_back():
+    eng = _engine(sparse_group_budget=64)
+    res = eng.sql(SQL)
+    assert "sparse budget" in (eng.last_plan.fallback_reason or "")
+    # fallback still answers correctly
+    ref = _engine().sql(SQL)
+    assert len(res) == len(ref)
+
+
+def test_merge_propagates_local_overflow():
+    """A chip whose LOCAL compact table overflowed dropped groups; the
+    merged count must still exceed cap so the runner retries larger."""
+    from tpu_olap.kernels.sparse_groupby import (merge_sparse,
+                                                 sparse_group_reduce)
+    from tpu_olap.kernels.groupby import AggPlan
+
+    cap = 64
+    plans = [AggPlan("n", "count", (), np.int64)]
+    env = {"cols": {}, "nulls": {}}
+    # chip A: 65 distinct keys -> local overflow drops one
+    key_a = np.arange(65, dtype=np.int64)
+    out_a = sparse_group_reduce(key_a, np.ones(65, bool), env, plans, cap,
+                                {}, np)
+    assert int(out_a["_count"]) == 65  # local overflow signalled
+    # chip B: subset of A's surviving keys
+    key_b = np.arange(32, dtype=np.int64)
+    out_b = sparse_group_reduce(key_b, np.ones(32, bool), env, plans, cap,
+                                {}, np)
+    merged = merge_sparse([out_a, out_b], plans, cap, np)
+    assert int(merged["_count"]) == 65  # NOT 64: retry must fire
+
+
+def test_sparse_theta_falls_back():
+    eng = _engine()
+    eng.sql("SELECT a, b, theta_sketch(c) AS d FROM t GROUP BY a, b")
+    assert not eng.last_plan.rewritten or \
+        "theta" in (eng.last_plan.fallback_reason or "")

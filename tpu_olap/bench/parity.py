@@ -21,6 +21,16 @@ class ParityError(AssertionError):
     pass
 
 
+def _f64(s: pd.Series) -> np.ndarray:
+    """float64 view with any NA flavor (np.nan, pd.NA, None) -> NaN."""
+    if pd.api.types.is_extension_array_dtype(s.dtype):
+        return s.to_numpy(dtype=np.float64, na_value=np.nan)
+    if s.dtype == object:
+        return np.asarray([np.nan if pd.isna(v) else float(v) for v in s],
+                          dtype=np.float64)
+    return s.to_numpy(dtype=np.float64)
+
+
 def run_both(engine, sql: str):
     """Execute `sql` on the accelerated path AND the fallback interpreter.
     Returns (device_df, fallback_df, plan). Raises if the planner did not
@@ -88,8 +98,8 @@ def assert_frame_parity(a: pd.DataFrame, b: pd.DataFrame,
                     f"{x[i]} vs {y[i]} (rtol={approx_rtol})")
             continue
         if is_float(av) or is_float(bv):
-            x = av.to_numpy(dtype=np.float64)
-            y = bv.to_numpy(dtype=np.float64)
+            x = _f64(av)
+            y = _f64(bv)
             both_nan = np.isnan(x) & np.isnan(y)
             bad = ~(np.isclose(x, y, rtol=float_rtol, atol=float_atol)
                     | both_nan)

@@ -26,6 +26,13 @@ class EngineConfig:
     # theta sketch nominal-entries cap (k × groups × 8B of HBM)
     theta_k_cap: int = 1 << 14
 
+    # sort-based sparse group-by (kernels.sparse_groupby), used when the
+    # dense mixed-radix space exceeds dense_group_budget: initial compact
+    # table size (adapts upward pow2 on overflow) and the hard ceiling of
+    # PRESENT groups before the query is declared non-rewritable.
+    sparse_group_cap: int = 1 << 15
+    sparse_group_budget: int = 1 << 21
+
     # segments per device dispatch (flattened rows = batch × block_rows)
     max_segments_per_dispatch: int = 1 << 10
 

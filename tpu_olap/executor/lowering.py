@@ -51,6 +51,8 @@ class PhysicalPlan:
     null_cols: tuple = ()
     virtual_exprs: dict = field(default_factory=dict)
     pallas_reason: str | None = "not attempted"  # None = pallas kernel active
+    sparse: bool = False       # sort-based path for huge group spaces
+    make_sparse_kernel: object = None   # cap -> kernel fn (sparse only)
 
     def fingerprint(self) -> tuple:
         import json
@@ -181,11 +183,27 @@ def _lower_agg(query, table, config) -> PhysicalPlan:
     total = 1
     for s in sizes:
         total *= s
-    if total > config.dense_group_budget:
-        raise UnsupportedAggregation(
-            f"group space {total} exceeds dense budget "
-            f"{config.dense_group_budget}")
-    if not config.enable_x64:
+    sparse = total > config.dense_group_budget
+    if sparse:
+        # sort-based sparse path (SURVEY.md §8.4 #1): GroupBy only (the
+        # timeseries/topN assemblers index the dense bucket space), no
+        # theta (its [cap, k] tables don't re-merge cheaply in phase 1)
+        if not isinstance(query, GroupByQuerySpec):
+            raise UnsupportedAggregation(
+                f"group space {total} exceeds dense budget "
+                f"{config.dense_group_budget} "
+                f"({query.query_type} has no sparse path)")
+        if total >= (1 << 62):
+            raise UnsupportedAggregation(
+                f"group space {total} overflows the int64 sparse key")
+        if not config.enable_x64:
+            raise UnsupportedAggregation(
+                "sparse group-by needs int64 keys (enable_x64=False)")
+        for p in agg_plans:
+            if p.kind == "theta":
+                raise UnsupportedAggregation(
+                    "theta sketch over a sparse group space")
+    if not sparse and not config.enable_x64:
         # sketch state is [groups × radix]; without 64-bit lanes the flat
         # scatter index must fit int32
         from tpu_olap.kernels.hll import NUM_REGISTERS
@@ -203,8 +221,7 @@ def _lower_agg(query, table, config) -> PhysicalPlan:
                                           vexprs, need_time)
     pruned = [s.meta.segment_id for s in table.prune(intervals)]
 
-    def kernel(env, valid, seg_mask, consts):
-        xp = np if isinstance(valid, np.ndarray) else _jnp()
+    def _masked_key(env, valid, seg_mask, consts, xp, key_builder):
         flat = {c: a.reshape(-1) for c, a in env["cols"].items()}
         nulls = {c: a.reshape(-1) for c, a in env["nulls"].items()}
         materialize_virtuals(vexprs, flat, nulls, xp)
@@ -222,23 +239,46 @@ def _lower_agg(query, table, config) -> PhysicalPlan:
             ids.append(dp.ids(fenv, consts, xp))
             radix.append(size)
         if ids:
-            key, _ = build_group_key(ids, radix, xp)
+            key, _ = key_builder(ids, radix, xp)
         else:
             key = xp.zeros(mask.shape, xp.int32)
+        return fenv, mask, key
+
+    def kernel(env, valid, seg_mask, consts):
+        xp = np if isinstance(valid, np.ndarray) else _jnp()
+        fenv, mask, key = _masked_key(env, valid, seg_mask, consts, xp,
+                                      build_group_key)
         return group_reduce(key, mask, fenv, agg_plans, total, consts)
+
+    def make_sparse_kernel(cap):
+        from tpu_olap.kernels.sparse_groupby import (build_group_key64,
+                                                     sparse_group_reduce)
+
+        def sparse_kernel(env, valid, seg_mask, consts):
+            xp = np if isinstance(valid, np.ndarray) else _jnp()
+            fenv, mask, key = _masked_key(env, valid, seg_mask, consts, xp,
+                                          build_group_key64)
+            return sparse_group_reduce(key.astype(xp.int64), mask, fenv,
+                                       agg_plans, cap, consts, xp)
+        return sparse_kernel
 
     statics = ("agg", sizes, bucket_plan.kind,
                tuple(dp.kind for dp in dim_plans),
                tuple((p.kind, p.name) for p in agg_plans),
-               filter_fn is not None, imask_fn is not None)
+               filter_fn is not None, imask_fn is not None,
+               "sparse" if sparse else "dense")
 
     plan = PhysicalPlan(
-        query=query, table=table, kind="agg", pool=pool, kernel=kernel,
+        query=query, table=table, kind="agg", pool=pool,
+        kernel=None if sparse else kernel,
         statics=statics, dim_plans=dim_plans, bucket_plan=bucket_plan,
         agg_plans=agg_plans, sizes=sizes, total_groups=total,
         pruned_ids=pruned, t_min=t_min, t_max=t_max, empty=empty,
-        columns=columns, null_cols=null_cols, virtual_exprs=vexprs)
-    _maybe_use_pallas(plan, query, table, config, filter_fn)
+        columns=columns, null_cols=null_cols, virtual_exprs=vexprs,
+        sparse=sparse, make_sparse_kernel=make_sparse_kernel if sparse
+        else None)
+    if not sparse:
+        _maybe_use_pallas(plan, query, table, config, filter_fn)
     return plan
 
 

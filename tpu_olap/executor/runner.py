@@ -75,12 +75,16 @@ class QueryRunner:
         reset — other tables' warm caches are left alone) and re-run;
         with degrade_shards_on_retry, halve the mesh — the in-process
         analog of re-sharding the segment manifest after chip loss."""
+        from tpu_olap.kernels.groupby import UnsupportedAggregation
+
         attempts = max(1, self.config.dispatch_retries + 1)
         for attempt in range(attempts):
             try:
                 if self.config.fault_injector is not None:
                     self.config.fault_injector("dispatch", attempt)
                 return call()
+            except UnsupportedAggregation:
+                raise  # structural, not transient: straight to fallback
             except Exception:
                 if attempt + 1 >= attempts:
                     raise
@@ -290,6 +294,72 @@ class QueryRunner:
         metrics["packed"] = True
         return idx, compact, layout
 
+    def _run_sparse(self, plan: PhysicalPlan, metrics: dict):
+        """Sort-based sparse group-by dispatch with adaptive compact-table
+        cap (kernels.sparse_groupby). Returns (partials dict, count)."""
+        from tpu_olap.kernels.groupby import UnsupportedAggregation
+
+        env, valid, seg_mask = self._prepare(plan, metrics)
+        mesh = self.mesh
+        n_shards = mesh.devices.size if mesh else 1
+        base_key = plan.fingerprint() + ("sparse", n_shards)
+        cap_limit = min(self.config.sparse_group_budget, plan.total_groups)
+        hint = self._cap_hints.get(base_key)
+        cap = min(cap_limit, self.config.sparse_group_cap) if hint is None \
+            else min(cap_limit, max(64, _next_pow2(2 * hint)))
+
+        t0 = time.perf_counter()
+        hit = False
+        if self.config.platform == "cpu":
+            while True:
+                out = plan.make_sparse_kernel(cap)(
+                    env, np.asarray(valid), seg_mask, plan.pool.consts)
+                count = int(out["_count"])
+                if count <= cap:
+                    break
+                if count > cap_limit:
+                    raise UnsupportedAggregation(
+                        f"{count} present groups exceed sparse budget "
+                        f"{cap_limit}")
+                cap = min(cap_limit, _next_pow2(count))
+            out = {k: np.asarray(v) for k, v in out.items()}
+            metrics["num_shards"] = 1
+        else:
+            import jax
+            consts_dev, seg_arg = self._args_for(plan, seg_mask, mesh)
+            while True:
+                key = base_key + (cap,)
+                jitted = self._jit_cache.get(key)
+                hit = jitted is not None
+                if not hit:
+                    kern = plan.make_sparse_kernel(cap)
+                    if mesh is not None:
+                        from tpu_olap.executor.sharding import \
+                            sharded_sparse_kernel
+                        jitted = jax.jit(sharded_sparse_kernel(
+                            kern, plan, mesh, cap))
+                    else:
+                        jitted = jax.jit(kern)
+                    self._jit_cache[key] = jitted
+                out = jitted(env, valid, seg_arg, consts_dev)
+                count = int(out["_count"])
+                if count <= cap:
+                    break
+                if count > cap_limit:
+                    raise UnsupportedAggregation(
+                        f"{count} present groups exceed sparse budget "
+                        f"{cap_limit}")
+                cap = min(cap_limit, _next_pow2(count))
+            out = {k: np.asarray(v) for k, v in out.items()}
+            metrics["num_shards"] = n_shards
+        self._cap_hints[base_key] = count
+        metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
+        metrics["cache_hit"] = hit
+        metrics["sparse"] = True
+        metrics["result_groups"] = count
+        metrics["result_cap"] = cap
+        return out, count
+
     # ------------------------------------------------------------ agg paths
 
     def _run_agg(self, query, table) -> QueryResult:
@@ -298,6 +368,20 @@ class QueryRunner:
         plan = lower(query, table, self.config)
         metrics["lower_ms"] = (time.perf_counter() - t0) * 1000
         specs = agg_specs_by_name(query.aggregations)
+
+        if plan.sparse:
+            out, count = self._dispatch(
+                lambda: self._run_sparse(plan, metrics), metrics, table.name)
+            t0 = time.perf_counter()
+            arrays = finalize_aggs(out, plan.agg_plans, specs)
+            eval_post_aggs(arrays, query.post_aggregations)
+            names = self._out_names(query)
+            present = out["_keys"][:count].astype(np.int64)
+            sub = {n: np.asarray(arrays[n])[:count] for n in names}
+            res = self._emit_groupby(query, plan, present, sub)
+            res.metrics = metrics
+            metrics["assemble_ms"] = (time.perf_counter() - t0) * 1000
+            return res
 
         packed = None
         if self.config.platform != "cpu":
@@ -385,8 +469,14 @@ class QueryRunner:
     def _assemble_groupby(self, query, plan, arrays) -> QueryResult:
         names = self._out_names(query)
         present = np.nonzero(arrays["_rows"] > 0)[0]
-        buckets, dim_vals = self._decode_groups(plan, present)
         sub = {n: np.asarray(arrays[n])[present] for n in names}
+        return self._emit_groupby(query, plan, present, sub)
+
+    def _emit_groupby(self, query, plan, present, sub) -> QueryResult:
+        """present: flat group ids (any int width); sub: compact per-group
+        final values. Shared tail of the dense and sparse paths."""
+        names = self._out_names(query)
+        buckets, dim_vals = self._decode_groups(plan, present)
 
         if query.having is not None:
             hmask = eval_having(query.having, sub, dim_vals)

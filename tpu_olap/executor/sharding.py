@@ -101,6 +101,43 @@ def sharded_kernel(plan, mesh: Mesh):
     return run
 
 
+def sharded_sparse_kernel(kernel, plan, mesh: Mesh, cap: int):
+    """Sparse (sort-based) group-by over the mesh: each chip reduces its
+    local segments to a compacted [cap] table, tables all_gather over ICI
+    ([D, cap] is small), and every chip re-merges by key — the sparse
+    analog of merge_collective (SURVEY.md §3.5 P2 with compaction standing
+    in for the dense-table allreduce)."""
+    from tpu_olap.kernels.sparse_groupby import merge_sparse
+
+    agg_plans = plan.agg_plans
+
+    def local(env, valid, seg_mask, consts):
+        out = kernel(env, valid, seg_mask, consts)
+        gathered = {k: jax.lax.all_gather(v, DATA_AXIS)
+                    for k, v in out.items()}
+        n = mesh.devices.size
+        parts = [{k: gathered[k][d] for k in out} for d in range(n)]
+        return merge_sparse(parts, agg_plans, cap, jnp)
+
+    def specs_like(env):
+        return {
+            "cols": {k: P(DATA_AXIS) for k in env["cols"]},
+            "nulls": {k: P(DATA_AXIS) for k in env["nulls"]},
+        }
+
+    def run(env, valid, seg_mask, consts):
+        f = jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(specs_like(env), P(DATA_AXIS), P(DATA_AXIS),
+                      jax.tree.map(lambda _: P(), consts)),
+            out_specs=P(),
+            check_vma=False,  # replicated by construction post-gather
+        )
+        return f(env, valid, seg_mask, consts)
+
+    return run
+
+
 def shard_put(arr: np.ndarray, mesh: Mesh):
     """Host array -> device array sharded on the leading axis."""
     return jax.device_put(arr, NamedSharding(mesh, P(DATA_AXIS)))

@@ -1,0 +1,221 @@
+"""Sort-based sparse group-by: high-cardinality GROUP BY on device.
+
+SURVEY.md §8.4 hard part #1: static shapes force a choice of group-table
+size. The dense path (kernels.groupby) materializes the full mixed-radix
+space [K] and stops at the dense budget; beyond it the reference-shaped
+answer would be a hash exchange, but sorting is the TPU-idiomatic move —
+XLA's sort is fast on TPU and everything stays static-shaped:
+
+  1. mixed-radix key in int64 (the radix product may exceed int32);
+     masked rows get the +inf sentinel so they sort to the tail;
+  2. one multi-operand `lax.sort` carries the key and every aggregate
+     input along;
+  3. group boundaries (key[i] != key[i-1]) -> cumsum -> dense ids in
+     [0, n_unique); ids clip to a `cap` slot table (+1 overflow slot that
+     also swallows the sentinel tail);
+  4. segment reduces into [cap] arrays; slot i holds the i-th smallest
+     present group key, so results are already compact AND sorted;
+  5. "_count" reports the true unique count — if it exceeds cap the
+     runner re-runs with the next power of two (same adaptive-cap pattern
+     as executor.packing).
+
+Multi-chip merge (P2, SURVEY.md §3.5): each chip's compacted [cap] table
+all-gathers over ICI ([D, cap] is small) and the SAME sort+reduce runs on
+the concatenation — partial sums re-sum, mins re-min, HLL registers
+re-max, theta tables re-merge.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpu_olap.kernels import hll as hll_mod
+from tpu_olap.kernels.groupby import (UnsupportedAggregation, _hash_fields,
+                                      _ident)
+
+SENTINEL = np.int64(np.iinfo(np.int64).max)
+
+
+def build_group_key64(ids, sizes, xp):
+    """Mixed-radix combine into int64. Callers guard product < 2^62."""
+    total = 1
+    for s in sizes:
+        total *= int(s)
+    if total >= (1 << 62):
+        raise UnsupportedAggregation(
+            f"group space {total} overflows the int64 key")
+    key = None
+    for i, s in zip(ids, sizes):
+        i = i.astype(xp.int64)
+        key = i if key is None else key * xp.int64(s) + i
+    if key is None:
+        key = xp.zeros((), xp.int64)
+    return key, total
+
+
+def _sorted_segments(skey, cap, xp):
+    """boundary/gid/count core shared by row reduction and table merge:
+    gid clips into the dropped overflow+sentinel slot `cap`."""
+    boundary = xp.concatenate([
+        xp.ones((1,), bool),
+        skey[1:] != skey[:-1],
+    ])
+    gid = xp.cumsum(boundary.astype(xp.int32)) - 1
+    count = (boundary & (skey != SENTINEL)).sum(dtype=xp.int32)
+    gid = xp.where((gid < cap) & (skey != SENTINEL), gid, cap)
+    return gid, count
+
+
+def _seg_sum(v, gid, cap, xp):
+    if xp is np:
+        out = np.zeros((cap + 1,) + v.shape[1:], v.dtype)
+        np.add.at(out, gid, v)
+        return out[:cap]
+    import jax
+    return jax.ops.segment_sum(v, gid, num_segments=cap + 1)[:cap]
+
+
+def _seg_ext(v, gid, cap, kind, xp):
+    if xp is np:
+        out = np.full((cap + 1,) + v.shape[1:], _ident(v.dtype, kind),
+                      v.dtype)
+        (np.minimum if kind == "min" else np.maximum).at(out, gid, v)
+        return out[:cap]
+    import jax
+    f = jax.ops.segment_min if kind == "min" else jax.ops.segment_max
+    return f(v, gid, num_segments=cap + 1)[:cap]
+
+
+def sparse_group_reduce(key, mask, env, plans, cap, consts, xp):
+    """[N] int64 keys + mask -> compacted per-group partials.
+
+    Returns {"_keys": [cap] int64 (SENTINEL marks empty slots),
+             "_count": [] int32 true unique count,
+             "_rows": [cap], <agg name>: [cap] or [cap, m], ...}.
+    """
+    import jax
+
+    key = xp.where(mask, key, SENTINEL)
+
+    operands = [key, mask]
+    slots = {}
+
+    def carry(name, arr):
+        slots[name] = len(operands)
+        operands.append(arr)
+
+    for p in plans:
+        m = mask if p.filter_fn is None else (mask & p.filter_fn(env, consts))
+        if p.filter_fn is not None:
+            carry(f"m:{p.name}", m)
+        if p.kind == "count":
+            continue
+        if p.kind in ("sum", "min", "max"):
+            x = env["cols"][p.fields[0]]
+            nulls = env["nulls"].get(p.fields[0])
+            mm = m & ~nulls if nulls is not None else m
+            if p.kind == "sum":
+                carry(f"v:{p.name}", xp.where(mm, x, 0).astype(p.acc_dtype))
+            else:
+                ident = _ident(p.acc_dtype, p.kind)
+                carry(f"v:{p.name}",
+                      xp.where(mm, x.astype(p.acc_dtype), ident))
+                carry(f"nn:{p.name}", mm)
+        elif p.kind == "hll":
+            h, valid = _hash_fields(env, p, m, xp, consts)
+            carry(f"h:{p.name}", h)
+            carry(f"hv:{p.name}", valid)
+        else:  # theta is dense/fallback-only (phase 1)
+            raise UnsupportedAggregation(
+                f"sparse group-by does not support {p.kind!r}")
+
+    if xp is np:
+        order = np.argsort(operands[0], kind="stable")
+        sorted_ops = [o[order] for o in operands]
+    else:
+        sorted_ops = list(jax.lax.sort(tuple(operands), num_keys=1))
+
+    skey = sorted_ops[0]
+    smask = sorted_ops[1]
+
+    gid, count = _sorted_segments(skey, cap, xp)
+
+    def seg_sum(v):
+        return _seg_sum(v, gid, cap, xp)
+
+    def seg_ext(v, kind):
+        return _seg_ext(v, gid, cap, kind, xp)
+
+    out = {"_count": count, "_rows": seg_sum(smask.astype(np.int32))}
+    out["_keys"] = seg_ext(skey, "min")  # all equal per group; SENTINEL fills
+
+    for p in plans:
+        m = smask if p.filter_fn is None else sorted_ops[slots[f"m:{p.name}"]]
+        if p.kind == "count":
+            out[p.name] = seg_sum(m.astype(p.acc_dtype))
+            continue
+        if p.kind == "sum":
+            out[p.name] = seg_sum(sorted_ops[slots[f"v:{p.name}"]])
+            continue
+        if p.kind in ("min", "max"):
+            out[p.name] = seg_ext(sorted_ops[slots[f"v:{p.name}"]], p.kind)
+            out[f"_nn_{p.name}"] = seg_sum(
+                sorted_ops[slots[f"nn:{p.name}"]].astype(np.int32))
+            continue
+        if p.kind == "hll":
+            h = sorted_ops[slots[f"h:{p.name}"]]
+            valid = sorted_ops[slots[f"hv:{p.name}"]]
+            regs = hll_mod.hll_update(h, valid, xp.where(valid, gid, 0),
+                                      cap + 1, xp)
+            out[p.name] = regs[:cap]
+            continue
+    return out
+
+
+def merge_sparse(parts: list, plans, cap, xp):
+    """Merge compacted tables (e.g. the [D, cap] slices of an all_gather):
+    concatenate and re-reduce by key. Values are already partial
+    aggregates, so the merge semantics differ from row reduction — sums
+    and counts re-sum, min/max re-extremize, HLL registers re-max, theta
+    re-merges pairwise."""
+    import jax
+
+    keys = xp.concatenate([p["_keys"] for p in parts])
+
+    if xp is np:
+        order = np.argsort(keys, kind="stable")
+    else:
+        (_, order) = jax.lax.sort(
+            (keys, xp.arange(keys.shape[0], dtype=xp.int32)), num_keys=1)
+        order = order.astype(xp.int32)
+    skey = keys[order]
+    gid, count = _sorted_segments(skey, cap, xp)
+    # a chip whose LOCAL table overflowed already dropped groups; the
+    # merged distinct count alone cannot see them, so take the max with
+    # every per-part count — the runner then retries with a larger cap
+    for p in parts:
+        if "_count" in p:
+            count = xp.maximum(count, p["_count"].astype(xp.int32))
+
+    def gathered(name):
+        return xp.concatenate([p[name] for p in parts])[order]
+
+    def seg_sum(v):
+        return _seg_sum(v, gid, cap, xp)
+
+    def seg_ext(v, kind):
+        return _seg_ext(v, gid, cap, kind, xp)
+
+    out = {"_count": count, "_rows": seg_sum(gathered("_rows"))}
+    out["_keys"] = seg_ext(skey, "min")
+    for p in plans:
+        if p.kind in ("count", "sum"):
+            out[p.name] = seg_sum(gathered(p.name))
+        elif p.kind in ("min", "max"):
+            out[p.name] = seg_ext(gathered(p.name), p.kind)
+            out[f"_nn_{p.name}"] = seg_sum(gathered(f"_nn_{p.name}"))
+        elif p.kind == "hll":
+            out[p.name] = seg_ext(gathered(p.name), "max")
+        else:
+            raise UnsupportedAggregation(p.kind)
+    return out

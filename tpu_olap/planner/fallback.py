@@ -206,8 +206,17 @@ def _aggregate(df, exprs, out_names, group_exprs, stmt, time_col):
 
     if order_cols:
         out = out.sort_values(order_cols, ascending=ascending,
-                              kind="stable")
+                              kind="stable", key=_null_low_key)
     return out[out_names].reset_index(drop=True)
+
+
+def _null_low_key(s: pd.Series) -> pd.Series:
+    """Sort key matching the device path's null placement: null == ""
+    for string dims (Druid's legacy null ordering) and -inf for numeric
+    keys, i.e. nulls FIRST ascending — pandas defaults put them last."""
+    if s.dtype == object or str(s.dtype).startswith(("string", "category")):
+        return s.map(lambda x: "" if pd.isna(x) else str(x))
+    return s.fillna(-np.inf) if s.isna().any() else s
 
 
 def _having_ok(having, sub, rec, time_col, agg_series) -> bool:

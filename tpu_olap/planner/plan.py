@@ -596,6 +596,13 @@ class _Rewriter:
             if isinstance(left, Lit):
                 left, right = right, left
                 op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+            if isinstance(left, Col) and not _contains_agg(left):
+                # HAVING may address an aggregate by its projection alias
+                # (Druid havingSpec names output aggregations)
+                for pe, alias in self.stmt.projections:
+                    if alias == left.name and _contains_agg(pe):
+                        left = self._resolve(pe)
+                        break
             if not isinstance(right, Lit) or not _contains_agg(left):
                 raise RewriteError(f"HAVING predicate not on an aggregate: "
                                    f"{_render(e)}")
