@@ -38,29 +38,69 @@ class Engine:
     def register_table(self, name: str, data, time_column: str | None = None,
                        star_schema=None, accelerate: bool = True,
                        block_rows: int = DEFAULT_BLOCK_ROWS,
-                       column_map: dict | None = None, **options):
+                       column_map: dict | None = None,
+                       columns=None, **options):
         """Register a datasource. `data`: pandas DataFrame, pyarrow Table,
         or parquet path. accelerate=False registers a plain (dimension)
         table served only by the fallback path — the reference's
-        non-druid-backed relation."""
+        non-druid-backed relation.
+
+        Parquet/Arrow inputs ingest straight from the Arrow columns (no
+        pandas detour) and the fallback DataFrame materializes lazily on
+        first fallback use. `columns` optionally prunes the ingested
+        column set — always POST-rename names (after column_map), for
+        every input type; parquet reads skip pruned columns entirely.
+        """
+        column_map = dict(column_map) if column_map else None
+        if column_map and time_column in column_map:
+            time_column = column_map[time_column]
+
+        def _renamed_arrow(tbl):
+            if column_map:
+                tbl = tbl.rename_columns(
+                    [column_map.get(c, c) for c in tbl.schema.names])
+            return tbl
+
         if isinstance(data, str):
             import pyarrow.parquet as pq
-            frame = pq.read_table(data).to_pandas()
+            path = data
+            inverse = {v: k for k, v in (column_map or {}).items()}
+            read_cols = [inverse.get(c, c) for c in columns] \
+                if columns else None
+
+            def load_frame(_path=path, _cols=read_cols):
+                f = pq.read_table(_path, columns=_cols).to_pandas()
+                return f.rename(columns=column_map) if column_map else f
+
+            table = _renamed_arrow(pq.read_table(path, columns=read_cols)) \
+                if accelerate else None
+            frame_source = load_frame
         elif isinstance(data, pd.DataFrame):
             frame = data.copy()
+            if column_map:
+                frame = frame.rename(columns=column_map)
+            if columns:
+                frame = frame[list(columns)]
+            import pyarrow as pa
+            table = pa.Table.from_pandas(frame, preserve_index=False) \
+                if accelerate else None
+            frame_source = frame
         else:  # pyarrow table
-            frame = data.to_pandas()
-        if column_map:
-            frame = frame.rename(columns=dict(column_map))
-            if time_column in (column_map or {}):
-                time_column = column_map[time_column]
+            table = _renamed_arrow(data)
+            if columns:
+                table = table.select(list(columns))
+
+            def frame_source(_t=table):
+                return _t.to_pandas()
+
         segments = None
         if accelerate:
-            segments = ingest_pandas(name, frame, time_column, block_rows)
+            segments = ingest_arrow(name, table, time_column, block_rows)
         star = star_schema
         if isinstance(star, dict):
             star = StarSchema.from_json(star)
-        entry = TableEntry(name=name, segments=segments, frame=frame,
+        entry = TableEntry(name=name, segments=segments,
+                           frame_source=frame_source,
                            time_column=time_column, star=star,
                            options=dict(options))
         self.catalog.register(entry)

@@ -19,10 +19,22 @@ from tpu_olap.segments.segment import TableSegments
 class TableEntry:
     name: str
     segments: TableSegments | None      # None: plain (dimension) table
-    frame: object                       # pandas DataFrame source of truth
+    # pandas DataFrame source of truth for the fallback path — either the
+    # frame itself or a zero-arg loader materialized on first access, so
+    # parquet-registered fact tables don't pay a duplicate pandas copy of
+    # data already resident as segments (SURVEY.md §8.4 #4 memory budget)
+    frame_source: object = None
     time_column: str | None = None
     star: StarSchema | None = None
     options: dict = field(default_factory=dict)
+    _frame: object = None
+
+    @property
+    def frame(self):
+        if self._frame is None:
+            src = self.frame_source
+            self._frame = src() if callable(src) else src
+        return self._frame
 
     @property
     def is_accelerated(self) -> bool:

@@ -11,7 +11,9 @@ from __future__ import annotations
 
 import numpy as np
 
-from tpu_olap.segments.segment import TableSegments
+from tpu_olap.segments.segment import ColumnType, TableSegments, TIME_COLUMN
+
+_I32_MIN, _I32_MAX = np.iinfo(np.int32).min + 1, np.iinfo(np.int32).max
 
 
 class DeviceDataset:
@@ -54,10 +56,32 @@ class DeviceDataset:
             rows = rows + [np.zeros_like(proto)] * fill
         return np.stack(rows)
 
+    def _narrow_dtype(self, name: str):
+        """int32 for LONG columns whose values all fit (per the segment
+        manifest's column min/max) — halves HBM residency and scan
+        bandwidth; sums still widen to the accumulator dtype on device.
+        __time stays int64 (epoch millis exceed int32)."""
+        if name == TIME_COLUMN or \
+                self.table.schema.get(name) is not ColumnType.LONG:
+            return None
+        lo = hi = None
+        for s in self.table.segments:
+            mlo = s.meta.column_min.get(name)
+            mhi = s.meta.column_max.get(name)
+            if mlo is None:
+                continue  # empty/all-null segment stores zero fill
+            lo = mlo if lo is None else min(lo, mlo)
+            hi = mhi if hi is None else max(hi, mhi)
+        if lo is None or (lo >= _I32_MIN and hi <= _I32_MAX):
+            return np.int32
+        return None
+
     def col(self, name: str):
         if name not in self._cols:
-            self._cols[name] = self._put(
-                self._stack(lambda s: s.columns[name]))
+            dt = self._narrow_dtype(name)
+            get = (lambda s: s.columns[name]) if dt is None else \
+                (lambda s: s.columns[name].astype(dt))
+            self._cols[name] = self._put(self._stack(get))
         return self._cols[name]
 
     def null_mask(self, name: str):

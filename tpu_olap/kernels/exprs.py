@@ -64,12 +64,36 @@ def virtual_null_mask(expr: Expr, nulls: dict, xp):
     return mask
 
 
-def materialize_virtuals(vexprs: dict, cols: dict, nulls: dict, xp) -> None:
+def widen_int_env(expr: Expr, cols: dict, xp) -> dict:
+    """Copy of `cols` with the expression's narrow-int inputs upcast to
+    int64: device columns may be stored int32 (executor.dataset narrow
+    storage), and products/sums must not wrap. XLA fuses the widening
+    into the consumer, so the HBM read stays narrow. No-op without x64
+    (int64 lanes unavailable — matches pre-narrowing behavior)."""
+    from tpu_olap.kernels.hashing import has_x64
+    if not has_x64(xp):
+        return cols
+    out = None
+    for c in expr.columns():
+        v = cols.get(c)
+        if v is not None and getattr(v, "dtype", None) is not None and \
+                v.dtype.kind in "iu" and v.dtype.itemsize < 8:
+            if out is None:
+                out = dict(cols)
+            out[c] = v.astype(xp.int64)
+    return out if out is not None else cols
+
+
+def materialize_virtuals(vexprs: dict, cols: dict, nulls: dict, xp,
+                         wide_ints: bool = True) -> None:
     """Evaluate every virtual column into `cols` AND attach its null mask
     to `nulls` (SQL null propagation). The single shared site for all
-    kernels — forgetting the mask half reintroduces a null-leak bug."""
+    kernels — forgetting the mask half reintroduces a null-leak bug.
+    wide_ints=False keeps narrow arithmetic (the Pallas kernel bounds
+    every intermediate to int32 at eligibility time)."""
     for name, ex in vexprs.items():
-        cols[name] = eval_expr(ex, cols, xp)
+        env = widen_int_env(ex, cols, xp) if wide_ints else cols
+        cols[name] = eval_expr(ex, env, xp)
         nm = virtual_null_mask(ex, nulls, xp)
         if nm is not None:
             nulls[name] = nm

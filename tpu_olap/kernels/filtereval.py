@@ -78,10 +78,11 @@ def compile_filter(spec, table, pool: ConstPool, virtual_exprs=None):
         return table.schema[col]
 
     def numeric_env(env):
+        from tpu_olap.kernels.exprs import widen_int_env
         xp = jnp if _is_jax(env) else np
         out = dict(env["cols"])
         for name, ex in virtual_exprs.items():
-            out[name] = eval_expr(ex, out, xp)
+            out[name] = eval_expr(ex, widen_int_env(ex, out, xp), xp)
         return out
 
     def lower(s):
@@ -117,8 +118,10 @@ def compile_filter(spec, table, pool: ConstPool, virtual_exprs=None):
                          if col in virtual_exprs else {col})
 
             def fn(env, c):
-                m = eval_expr(expr, numeric_env(env),
-                              jnp if _is_jax(env) else np) != 0
+                from tpu_olap.kernels.exprs import widen_int_env
+                xp = jnp if _is_jax(env) else np
+                ne = numeric_env(env)
+                m = eval_expr(expr, widen_int_env(expr, ne, xp), xp) != 0
                 # NULL in any referenced input -> no match (boolean, not 3VL)
                 for col in phys:
                     m = m & ~_null_mask(env, col)

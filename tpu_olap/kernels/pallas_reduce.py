@@ -281,7 +281,8 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool):
                 env["cols"][name] = r[0, :]
             for name, r in zip(null_names, null_refs):
                 env["nulls"][name] = r[0, :]
-            materialize_virtuals(vexprs, env["cols"], env["nulls"], jnp)
+            materialize_virtuals(vexprs, env["cols"], env["nulls"], jnp,
+                                 wide_ints=False)
             consts = {n: r[0, :] for n, r in zip(const_names, const_refs)}
 
             mask = valid_ref[0, :]

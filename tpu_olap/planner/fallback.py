@@ -166,7 +166,7 @@ def _aggregate(df, exprs, out_names, group_exprs, stmt, time_col):
     filled = kdf.copy()
     for c in filled.columns:
         if filled[c].dtype == object or str(filled[c].dtype).startswith(
-                ("string", "category")):
+                ("str", "category")):
             filled[c] = filled[c].fillna(fill)
     # pre-resolve ORDER BY items to either an output column or an
     # extra computed key evaluated per group
@@ -213,10 +213,30 @@ def _aggregate(df, exprs, out_names, group_exprs, stmt, time_col):
 def _null_low_key(s: pd.Series) -> pd.Series:
     """Sort key matching the device path's null placement: null == ""
     for string dims (Druid's legacy null ordering) and -inf for numeric
-    keys, i.e. nulls FIRST ascending — pandas defaults put them last."""
-    if s.dtype == object or str(s.dtype).startswith(("string", "category")):
-        return s.map(lambda x: "" if pd.isna(x) else str(x))
-    return s.fillna(-np.inf) if s.isna().any() else s
+    keys, i.e. nulls FIRST ascending — pandas defaults put them last.
+    Aggregate outputs arrive as object dtype whenever a group's value is
+    NULL, so object columns are re-typed by inspecting their values
+    (stringifying numbers would sort them lexicographically)."""
+    if pd.api.types.is_datetime64_any_dtype(s):
+        return s.fillna(pd.Timestamp.min)
+    if pd.api.types.is_extension_array_dtype(s.dtype) and \
+            pd.api.types.is_numeric_dtype(s):
+        return pd.Series(s.to_numpy(dtype=np.float64, na_value=-np.inf),
+                         index=s.index)
+    if s.dtype == object or str(s.dtype).startswith(("str", "category")):
+        # explicit comprehensions, NOT Series.map: pandas 3 skips NA values
+        # by default, which would leave nulls sorting last again
+        non_null = [v for v in s if not pd.isna(v)]
+        if non_null and all(
+                isinstance(v, (int, float, np.integer, np.floating))
+                and not isinstance(v, bool) for v in non_null):
+            return pd.Series([-np.inf if pd.isna(v) else float(v)
+                              for v in s], index=s.index)
+        return pd.Series(["" if pd.isna(v) else str(v) for v in s],
+                         index=s.index)
+    if pd.api.types.is_float_dtype(s) and s.isna().any():
+        return s.fillna(-np.inf)
+    return s
 
 
 def _having_ok(having, sub, rec, time_col, agg_series) -> bool:
