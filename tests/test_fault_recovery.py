@@ -49,12 +49,48 @@ def test_retry_recovers():
     pd.testing.assert_frame_equal(got, ref.sql(SQL))
 
 
-def test_retry_exhaustion_raises():
+def test_retry_exhaustion_falls_back():
+    """SURVEY.md §2 property 2: after retries exhaust on a non-structural
+    failure, the engine answers correctly (slow path), never errors."""
     inj = FlakyInjector(10)
     eng = Engine(EngineConfig(dispatch_retries=1, fault_injector=inj))
     eng.register_table("t", _df(), time_column="ts", block_rows=512)
+    got = eng.sql(SQL)
+    assert eng.last_plan.fallback_reason.startswith("device failure")
+    assert eng.runner.history[-1]["retry_errors"]
+    ref = Engine()
+    ref.register_table("t", _df(), time_column="ts", block_rows=512)
+    pd.testing.assert_frame_equal(got, ref.sql(SQL))
+
+
+def test_retry_exhaustion_raises_when_fallback_disabled():
+    inj = FlakyInjector(10)
+    eng = Engine(EngineConfig(dispatch_retries=1, fault_injector=inj,
+                              fallback_on_device_failure=False))
+    eng.register_table("t", _df(), time_column="ts", block_rows=512)
     with pytest.raises(RuntimeError, match="injected fault"):
         eng.sql(SQL)
+
+
+def test_deadline_falls_back():
+    """Per-query deadline (the task-kill -> query-abort analog): a wedged
+    dispatch times out and the engine still answers via fallback."""
+    import time as _time
+
+    def slow_injector(stage, attempt):
+        _time.sleep(2.0)
+
+    eng = Engine(EngineConfig(query_deadline_s=0.3,
+                              fault_injector=slow_injector,
+                              dispatch_retries=0))
+    eng.register_table("t", _df(), time_column="ts", block_rows=512)
+    t0 = _time.perf_counter()
+    got = eng.sql(SQL)
+    assert "QueryDeadlineExceeded" in eng.last_plan.fallback_reason
+    assert eng.runner.history[-1].get("deadline_exceeded")
+    ref = Engine()
+    ref.register_table("t", _df(), time_column="ts", block_rows=512)
+    pd.testing.assert_frame_equal(got, ref.sql(SQL))
 
 
 def test_shard_degradation():

@@ -32,6 +32,13 @@ class Engine:
         self.runner = QueryRunner(self.config)
         self.planner = DruidPlanner(self.catalog, self.config)
         self.last_plan = None
+        # Serializes device dispatch only (the runner's compile/arg caches
+        # are not concurrent and the chip has one program queue anyway,
+        # SURVEY.md §3.5 P1). Planning and the pandas fallback run outside
+        # it, so concurrent HTTP clients aren't wedged behind one slow
+        # device query (VERDICT round 1 "missing" #6).
+        import threading
+        self.device_lock = threading.RLock()
 
     # ------------------------------------------------------- registration
 
@@ -122,13 +129,28 @@ class Engine:
         plan = self.planner.plan(query)
         self.last_plan = plan
         if plan.rewritten:
+            res = None
             try:
-                res = self.runner.execute(plan.query,
-                                          plan.entry.segments)
-                return self._frame_from(plan, res)
+                with self.device_lock:
+                    res = self.runner.execute(plan.query,
+                                              plan.entry.segments)
             except _UNSUPPORTED as e:
                 plan.query = None
                 plan.fallback_reason = f"lowering failed: {e}"
+            except Exception as e:
+                # Structural "never an error" guarantee (SURVEY.md §2
+                # property 2): dispatch retries exhausted on a
+                # non-structural failure (device loss, deadline, compiler
+                # bug) -> correct-but-slow fallback, not a user error.
+                if not self.config.fallback_on_device_failure:
+                    raise
+                plan.query = None
+                plan.fallback_reason = \
+                    f"device failure: {type(e).__name__}: {e}"
+            if res is not None:
+                # conversion bugs in _frame_from must surface, not be
+                # silently reclassified as device failures
+                return self._frame_from(plan, res)
         return execute_fallback(plan.stmt, self.catalog, self.config)
 
     def _frame_from(self, plan, res: QueryResult) -> pd.DataFrame:
@@ -175,14 +197,16 @@ class Engine:
         if not entry.is_accelerated:
             raise ValueError(
                 f"table {query.data_source!r} is not accelerated")
-        return self.runner.execute(query, entry.segments)
+        with self.device_lock:
+            return self.runner.execute(query, entry.segments)
 
     # -------------------------------------------------------------- admin
 
     def clear_cache(self, table: str | None = None):
         """CLEAR DRUID CACHE analog: drop device-resident columns and
         compiled programs (catalog entries stay registered)."""
-        self.runner.clear_cache(table)
+        with self.device_lock:
+            self.runner.clear_cache(table)
 
     @property
     def history(self):

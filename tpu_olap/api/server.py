@@ -13,9 +13,12 @@ equivalent is a JSON-over-HTTP surface (stdlib only, no new deps):
   GET  /status       engine + per-table summary + counters
   GET  /status/metadata/<table>  column metadata (segmentMetadata shape)
 
-Queries serialize through a lock: the engine's compile/arg caches are not
-concurrent, and a single TPU program queue is the execution model anyway
-(SURVEY.md §3.5 P1).
+Concurrency: requests run on ThreadingHTTPServer threads; only device
+dispatch serializes (Engine.device_lock — the chip has one program queue,
+SURVEY.md §3.5 P1). Fallback-path queries, statement verbs, and status
+endpoints proceed while a device query runs, and
+EngineConfig.query_deadline_s bounds how long any one dispatch can wedge
+the queue.
 """
 
 from __future__ import annotations
@@ -25,23 +28,33 @@ import math
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+import pandas as pd
+
 
 def _jsonable(x):
-    """Strict-JSON sanitizer: NaN/inf -> null (SQL-null semantics); BI
-    clients reject bare NaN/Infinity literals."""
+    """Strict-JSON sanitizer: NaN/inf and SQL nulls that surface as pandas
+    scalars (NaT, pd.NA) -> JSON null; BI clients reject bare NaN/Infinity
+    literals and would otherwise receive the strings "NaT"/"<NA>" via
+    default=str."""
     if isinstance(x, float) and not math.isfinite(x):
         return None
     if isinstance(x, dict):
         return {k: _jsonable(v) for k, v in x.items()}
     if isinstance(x, (list, tuple)):
         return [_jsonable(v) for v in x]
+    if x is None or isinstance(x, (str, int, bool)):
+        return x
+    try:
+        if pd.isna(x):
+            return None
+    except (TypeError, ValueError):
+        pass
     return x
 
 
 class QueryServer:
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0):
         self.engine = engine
-        self._lock = threading.Lock()
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -108,8 +121,10 @@ class QueryServer:
                 "engine": "tpu_olap",
                 "tables": {name: {
                     "accelerated": e.is_accelerated,
+                    # null until the lazy fallback frame materializes —
+                    # a monitoring ping must not force a parquet load
                     "numRows": (e.segments.num_rows if e.is_accelerated
-                                else len(e.frame)),
+                                else e.materialized_rows),
                 } for name, e in ((n, eng.catalog.get(n))
                                   for n in eng.catalog.names())},
                 "counters": eng.counters(),
@@ -126,13 +141,11 @@ class QueryServer:
     def _post(self, path: str, body: str):
         if path == "/sql":
             req = json.loads(body)
-            with self._lock:
-                frame = self.engine.sql(req["query"])
+            frame = self.engine.sql(req["query"])
             return {"columns": list(frame.columns),
                     "rows": frame.to_dict("records")}
         if path in ("/druid/v2", "/druid/v2/"):
             spec = json.loads(body)
-            with self._lock:
-                res = self.engine.execute_ir(spec)
+            res = self.engine.execute_ir(spec)
             return res.druid
         raise KeyError(f"unknown path {path!r}")

@@ -9,6 +9,7 @@ carries the sourceDataframe ref). clear() is `CLEAR DRUID CACHE`.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 
 from tpu_olap.catalog.star import StarSchema
@@ -28,13 +29,26 @@ class TableEntry:
     star: StarSchema | None = None
     options: dict = field(default_factory=dict)
     _frame: object = None
+    _frame_lock: object = field(default_factory=threading.Lock,
+                                repr=False, compare=False)
 
     @property
     def frame(self):
         if self._frame is None:
-            src = self.frame_source
-            self._frame = src() if callable(src) else src
+            # double-checked under a per-entry lock: concurrent fallback
+            # queries must not each materialize a multi-GB parquet frame,
+            # and independent tables must not serialize each other
+            with self._frame_lock:
+                if self._frame is None:
+                    src = self.frame_source
+                    self._frame = src() if callable(src) else src
         return self._frame
+
+    @property
+    def materialized_rows(self) -> int | None:
+        """Row count of the fallback frame if already materialized, else
+        None — monitoring must never force a lazy parquet load."""
+        return len(self._frame) if self._frame is not None else None
 
     @property
     def is_accelerated(self) -> bool:

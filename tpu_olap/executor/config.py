@@ -74,9 +74,23 @@ class EngineConfig:
     # analog of the reference's Spark task retry over DruidRDD partitions).
     dispatch_retries: int = 1
     degrade_shards_on_retry: bool = False
+    # structural "never an error" guarantee (SURVEY.md §2 property 2):
+    # after dispatch retries exhaust on a NON-structural failure, run the
+    # pandas fallback instead of raising. Off = propagate (debugging).
+    fallback_on_device_failure: bool = True
+    # per-query deadline (seconds) on the device dispatch; on expiry the
+    # engine falls back (the analog of the reference's task-kill -> HTTP
+    # query abort, SURVEY.md §3.5). None = no deadline.
+    query_deadline_s: float | None = None
     # test hook: callable(stage: str, attempt: int) -> None, may raise to
     # inject a dispatch fault (None in production)
     fault_injector: object = None
+
+    # tracing (SURVEY.md §6): when set, each query dispatch runs under a
+    # jax.profiler trace written beneath this directory; the history record
+    # gets a "profile_trace" pointer. Opt-in — per-query profiler start/stop
+    # costs milliseconds.
+    profile_dir: str | None = None
 
     # Pallas fused one-hot MXU reduce (kernels.pallas_reduce): "auto" uses
     # it on the TPU backend for eligible plans, "force" uses it everywhere

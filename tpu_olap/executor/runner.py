@@ -44,6 +44,14 @@ class QueryResult:
         return pd.DataFrame(self.rows)
 
 
+class QueryDeadlineExceeded(Exception):
+    """Raised when a query exceeds EngineConfig.query_deadline_s. The
+    in-process analog of the reference's task-kill -> HTTP query abort
+    (SURVEY.md §3.5): the caller falls back; the abandoned dispatch thread
+    finishes (and is discarded) in the background since an in-flight XLA
+    computation cannot be interrupted."""
+
+
 class QueryRunner:
     def __init__(self, config: EngineConfig | None = None):
         self.config = config or EngineConfig()
@@ -58,6 +66,8 @@ class QueryRunner:
         self._cap_hints: dict = {}   # template -> last observed group count
         self._mesh = None
         self._active_shards = config.num_shards if config else None
+        self._last_metrics: dict = {}
+        self._deadline_pool = None
         self.history: list = []
 
     @property
@@ -85,7 +95,11 @@ class QueryRunner:
                 return call()
             except UnsupportedAggregation:
                 raise  # structural, not transient: straight to fallback
-            except Exception:
+            except Exception as e:
+                # record every retried error so poisoned-device vs
+                # deterministic failures are distinguishable in history
+                metrics.setdefault("retry_errors", []).append(
+                    f"{type(e).__name__}: {e}")
                 if attempt + 1 >= attempts:
                     raise
                 metrics["retries"] = attempt + 1
@@ -102,7 +116,70 @@ class QueryRunner:
     # ------------------------------------------------------------------ API
 
     def execute(self, query, table) -> QueryResult:
+        deadline = self.config.query_deadline_s
+        if deadline is not None:
+            import concurrent.futures
+            import threading
+            if self._deadline_pool is None:
+                # one persistent worker: all deadline-mode dispatches run
+                # on a single thread, so an abandoned (timed-out) dispatch
+                # and the next query's dispatch can never mutate the
+                # runner's caches concurrently — the next query just
+                # queues behind the wedge and times out in turn
+                self._deadline_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="tpu-olap-dispatch")
+            abandoned = threading.Event()
+            fut = self._deadline_pool.submit(
+                self._execute, query, table, abandoned)
+            try:
+                return fut.result(timeout=deadline)
+            except concurrent.futures.TimeoutError:
+                abandoned.set()  # its history record is discarded
+                self.history.append({
+                    "query_type": query.query_type,
+                    "datasource": table.name,
+                    "deadline_exceeded": True,
+                    "total_ms": deadline * 1000,
+                })
+                raise QueryDeadlineExceeded(
+                    f"query exceeded deadline of {deadline}s") from None
+        return self._execute(query, table)
+
+    def _execute(self, query, table, abandoned=None) -> QueryResult:
         t0 = time.perf_counter()
+        self._last_metrics = {}
+        try:
+            if self.config.profile_dir is not None:
+                import os
+                import jax
+                trace_dir = os.path.join(
+                    self.config.profile_dir,
+                    f"q{len(self.history):05d}_{query.query_type}")
+                with jax.profiler.trace(trace_dir):
+                    res = self._execute_inner(query, table)
+                res.metrics["profile_trace"] = trace_dir
+            else:
+                res = self._execute_inner(query, table)
+        except Exception:
+            # failed queries still leave an observability record (with
+            # retry_errors) so poisoned-device vs deterministic failures
+            # are diagnosable from history
+            m = self._last_metrics
+            m["failed"] = True
+            m["query_type"] = query.query_type
+            m["datasource"] = table.name
+            m["total_ms"] = (time.perf_counter() - t0) * 1000
+            if abandoned is None or not abandoned.is_set():
+                self.history.append(m)
+            raise
+        res.metrics["total_ms"] = (time.perf_counter() - t0) * 1000
+        res.metrics["query_type"] = query.query_type
+        res.metrics["datasource"] = table.name
+        if abandoned is None or not abandoned.is_set():
+            self.history.append(res.metrics)
+        return res
+
+    def _execute_inner(self, query, table) -> QueryResult:
         if isinstance(query, TimeBoundaryQuerySpec):
             res = self._run_time_boundary(query, table)
         elif isinstance(query, SegmentMetadataQuerySpec):
@@ -116,10 +193,6 @@ class QueryRunner:
             res = self._run_agg(query, table)
         else:
             raise TypeError(f"unknown query type {type(query).__name__}")
-        res.metrics["total_ms"] = (time.perf_counter() - t0) * 1000
-        res.metrics["query_type"] = query.query_type
-        res.metrics["datasource"] = table.name
-        self.history.append(res.metrics)
         return res
 
     def clear_cache(self, table_name: str | None = None):
@@ -363,7 +436,7 @@ class QueryRunner:
     # ------------------------------------------------------------ agg paths
 
     def _run_agg(self, query, table) -> QueryResult:
-        metrics = {}
+        metrics = self._last_metrics = {}
         t0 = time.perf_counter()
         plan = lower(query, table, self.config)
         metrics["lower_ms"] = (time.perf_counter() - t0) * 1000
@@ -549,7 +622,7 @@ class QueryRunner:
     # ----------------------------------------------------------- scan paths
 
     def _run_scan(self, query, table) -> QueryResult:
-        metrics = {}
+        metrics = self._last_metrics = {}
         t0 = time.perf_counter()
         plan = lower(query, table, self.config)
         metrics["lower_ms"] = (time.perf_counter() - t0) * 1000
