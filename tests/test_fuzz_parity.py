@@ -15,16 +15,20 @@ from tpu_olap import Engine
 from tpu_olap.bench.parity import ParityError, assert_frame_parity, run_both
 from tpu_olap.executor import EngineConfig
 
-N_CASES = 40
+N_CASES = 200
+
+_CITY_REGION = {f"city{i}": ("west" if i < 5 else "east") for i in range(9)}
 
 
 def _make_table(rng, n):
+    cities = rng.choice([f"city{i}" for i in range(9)], n)
     frame = pd.DataFrame({
         "ts": pd.to_datetime("2019-03-01")
         + pd.to_timedelta(rng.integers(0, 86400 * 400, n), unit="s"),
         "cat": rng.choice(["alpha", "beta", "gamma", "delta", None], n,
                           p=[0.3, 0.3, 0.2, 0.15, 0.05]),
-        "城市": rng.choice([f"city{i}" for i in range(9)], n),
+        "城市": cities,
+        "region": np.array([_CITY_REGION[c] for c in cities], object),
         "small": rng.integers(0, 7, n).astype(np.int64),
         "qty": rng.integers(-50, 200, n).astype(np.int64),
         "price": np.round(rng.random(n) * 1000, 3),
@@ -35,37 +39,82 @@ def _make_table(rng, n):
     return frame
 
 
-_DIMS = ["cat", "城市", "small"]
+def _city_dim():
+    return pd.DataFrame({
+        "d_city": list(_CITY_REGION),
+        "d_region": list(_CITY_REGION.values()),
+    })
+
+
+def _star():
+    from tpu_olap.catalog.star import StarDimension, StarSchema
+    return StarSchema(
+        fact="t",
+        dimensions=(StarDimension(
+            "citydim", fact_key="城市", dim_key="d_city",
+            column_map={"d_city": "城市", "d_region": "region"}),))
+
+
+_DIMS = ["cat", "城市", "small", "region"]
 _AGGS = [
     ("sum(qty)", "sq"), ("sum(price)", "sp"), ("count(*)", "n"),
     ("min(price)", "mp"), ("max(qty)", "xq"), ("avg(price)", "ap"),
     ("sum(qty * small)", "svs"), ("sum(price + qty)", "spq"),
     ("count(qty > 25)", "cge"),  # null comparison -> null -> not counted
+    ("sum(CASE WHEN qty > 25 THEN qty ELSE 0 END)", "scw"),
+    ("sum(CAST(price AS INT))", "sci"),
+    ("max(CAST(qty AS DOUBLE))", "xcd"),
 ]
 _FILTERS = [
     "qty > 25", "qty BETWEEN -10 AND 80", "price < 500.5",
     "cat = 'alpha'", "cat IN ('beta', 'gamma')", "cat IS NOT NULL",
     "城市 LIKE 'city1%'", "NOT (small = 3)",
     "small IN (1, 2, 5) OR qty < 0", "cat IS NULL",
+    "substr(城市, 5, 1) = '3'",
+    "(ts >= '2019-05-01' AND ts < '2019-08-01') "
+    "OR (ts >= '2019-11-01' AND ts < '2020-01-15')",
 ]
-_TIME_EXPRS = [None, "year(ts)", "month(ts)", "date_trunc('day', ts)"]
+_TIME_EXPRS = [None, "year(ts)", "month(ts)", "quarter(ts)",
+               "date_trunc('day', ts)"]
+_EXTRACT_DIMS = ["substr(城市, 1, 5)", "regexp_extract(cat, '^(a|b)')"]
 
 
 def _gen_query(rng):
     n_dims = int(rng.integers(0, 3))
     dims = list(rng.choice(_DIMS, size=n_dims, replace=False))
+    join = rng.random() < 0.25
+    if join and "region" in dims:
+        # reach region through the star join instead of the fact column
+        dims[dims.index("region")] = "d_region"
     texpr = _TIME_EXPRS[rng.integers(0, len(_TIME_EXPRS))]
     aggs = [_AGGS[i] for i in
             rng.choice(len(_AGGS), size=rng.integers(1, 4), replace=False)]
 
     select = list(dims)
     group = list(dims)
+    if rng.random() < 0.15:
+        ex = _EXTRACT_DIMS[rng.integers(0, len(_EXTRACT_DIMS))]
+        select.append(f"{ex} AS xd")
+        group.append(ex)
     if texpr is not None and rng.random() < 0.6:
         select.append(f"{texpr} AS tg")
         group.append(texpr)
-    select += [f"{e} AS {a}" for e, a in aggs]
 
-    sql = "SELECT " + ", ".join(select) + " FROM t"
+    from_clause = " FROM t"
+    if join:
+        from_clause = " FROM t JOIN citydim ON 城市 = d_city"
+
+    if not aggs or (group and rng.random() < 0.1):
+        pass
+    if group and not select:
+        select = list(group)
+    distinct = rng.random() < 0.1 and group
+    if distinct:
+        sql = "SELECT DISTINCT " + ", ".join(group) + from_clause
+        group = []
+    else:
+        select += [f"{e} AS {a}" for e, a in aggs]
+        sql = "SELECT " + ", ".join(select) + from_clause
     n_filters = int(rng.integers(0, 3))
     if n_filters:
         fs = list(rng.choice(_FILTERS, size=n_filters, replace=False))
@@ -77,11 +126,20 @@ def _gen_query(rng):
     if rng.random() < 0.5 and group:
         # order by EVERY group key so LIMIT selects a unique row set —
         # ties under a partial ORDER BY may legally differ between paths
-        keys = [g if g in dims else "tg" for g in group]
+        keys = []
+        for g in group:
+            if g in dims:
+                keys.append(g)
+            elif g in _EXTRACT_DIMS:
+                keys.append("xd")
+            else:
+                keys.append("tg")
         direction = "DESC" if rng.random() < 0.5 else "ASC"
         sql += " ORDER BY " + ", ".join(f"{k} {direction}" for k in keys)
         if rng.random() < 0.5:
             sql += f" LIMIT {int(rng.integers(1, 30))}"
+            if rng.random() < 0.4:
+                sql += f" OFFSET {int(rng.integers(0, 10))}"
     return sql
 
 
@@ -93,7 +151,9 @@ def test_fuzz_parity(seed):
     shards = 8 if seed % 5 == 0 else None
     eng = Engine(EngineConfig(use_pallas=pallas, num_shards=shards))
     eng.register_table("t", frame, time_column="ts",
-                       block_rows=int(2 ** rng.integers(8, 11)))
+                       block_rows=int(2 ** rng.integers(8, 11)),
+                       star_schema=_star())
+    eng.register_table("citydim", _city_dim(), accelerate=False)
     sql = _gen_query(rng)
     try:
         device, fb, plan = run_both(eng, sql)

@@ -1,0 +1,190 @@
+"""FD-driven star-schema join subsumption (SURVEY.md §3.2 JoinTransform,
+§3.4 StarSchema): snowflake dim⋈dim chain collapse, FunctionalDependency-
+implied links, join-order independence, and negative (non-subsumed) cases.
+The fixture is an SSB-flavored nation→region chain (VERDICT r1 #5)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpu_olap import Engine
+from tpu_olap.catalog.star import (FunctionalDependency, StarDimension,
+                                   StarSchema)
+
+_REGIONS = {"ams": ("NETHERLANDS", 1), "ber": ("GERMANY", 1),
+            "nyc": ("UNITED STATES", 2), "rio": ("BRAZIL", 2),
+            "osa": ("JAPAN", 3)}
+_REGION_NAMES = {1: "EUROPE", 2: "AMERICA", 3: "ASIA"}
+
+
+def _fixture(with_fd: bool):
+    rng = np.random.default_rng(11)
+    n = 4000
+    city = rng.choice(list(_REGIONS), n)
+    nation = np.array([_REGIONS[c][0] for c in city], object)
+    region = np.array([_REGION_NAMES[_REGIONS[c][1]] for c in city], object)
+    fact = pd.DataFrame({
+        "ts": pd.to_datetime("2023-03-01")
+        + pd.to_timedelta(rng.integers(0, 86400 * 20, n), unit="s"),
+        "c_city": city,
+        "c_nation": nation,
+        "c_region": region,
+        "amount": rng.integers(1, 900, n).astype(np.int64),
+    })
+    nation_t = pd.DataFrame({
+        "n_name": [v[0] for v in _REGIONS.values()],
+        "n_regionkey": [v[1] for v in _REGIONS.values()],
+    }).drop_duplicates()
+    region_t = pd.DataFrame({
+        "r_regionkey": list(_REGION_NAMES),
+        "r_name": list(_REGION_NAMES.values()),
+    })
+    fds = (FunctionalDependency("c_city", "c_nation"),
+           FunctionalDependency("c_nation", "c_region"))
+    if with_fd:
+        # the fact column c_nation functionally determines the (absent)
+        # nation surrogate key — this is what licenses joining region
+        # without materializing the nation table in the query
+        fds += (FunctionalDependency("c_nation", "n_regionkey"),)
+    star = StarSchema(
+        fact="fact",
+        dimensions=(
+            StarDimension("nation", fact_key="c_nation", dim_key="n_name",
+                          column_map={"n_name": "c_nation"}),
+            StarDimension("region", fact_key="n_regionkey",
+                          dim_key="r_regionkey",
+                          column_map={"r_name": "c_region"}),
+        ),
+        functional_dependencies=fds)
+    eng = Engine()
+    eng.register_table("fact", fact, time_column="ts", star_schema=star)
+    eng.register_table("nation", nation_t, accelerate=False)
+    eng.register_table("region", region_t, accelerate=False)
+    return eng, fact
+
+
+CHAIN_SQL = ("SELECT r_name, sum(amount) AS s FROM fact "
+             "JOIN nation ON c_nation = n_name "
+             "JOIN region ON n_regionkey = r_regionkey "
+             "GROUP BY r_name ORDER BY r_name")
+
+
+def _expected(fact):
+    return (fact.groupby("c_region", as_index=False)
+            .agg(s=("amount", "sum"))
+            .rename(columns={"c_region": "r_name"})
+            .sort_values("r_name").reset_index(drop=True))
+
+
+def test_snowflake_chain_collapses():
+    eng, fact = _fixture(with_fd=False)
+    got = eng.sql(CHAIN_SQL)
+    assert eng.last_plan.rewritten, eng.last_plan.fallback_reason
+    pd.testing.assert_frame_equal(got, _expected(fact), check_dtype=False)
+
+
+def test_snowflake_chain_parity_vs_fallback():
+    """The pandas fallback executes the same chain with real merges —
+    results must match the collapsed device plan exactly."""
+    eng, fact = _fixture(with_fd=False)
+    got = eng.sql(CHAIN_SQL)
+    from tpu_olap.planner.fallback import execute_fallback
+    ref = execute_fallback(eng.planner.plan(CHAIN_SQL).stmt, eng.catalog,
+                           eng.config)
+    pd.testing.assert_frame_equal(
+        got.reset_index(drop=True),
+        ref.sort_values("r_name").reset_index(drop=True), check_dtype=False)
+
+
+def test_chain_join_order_independent():
+    """Region listed before nation still collapses (the reference walks
+    the whole join tree, not a left-to-right list)."""
+    eng, fact = _fixture(with_fd=False)
+    sql = ("SELECT r_name, sum(amount) AS s FROM fact "
+           "JOIN region ON n_regionkey = r_regionkey "
+           "JOIN nation ON c_nation = n_name "
+           "GROUP BY r_name ORDER BY r_name")
+    got = eng.sql(sql)
+    assert eng.last_plan.rewritten, eng.last_plan.fallback_reason
+    pd.testing.assert_frame_equal(got, _expected(fact), check_dtype=False)
+
+
+def test_fd_implied_link_without_intermediate_table():
+    """With FD c_nation → n_regionkey declared, region joins WITHOUT the
+    nation table in the query: the link column is implied, not
+    materialized. This query is planner-only territory — the pandas
+    fallback cannot execute it (no n_regionkey column anywhere in the
+    FROM) — exactly the reference's FD payoff."""
+    eng, fact = _fixture(with_fd=True)
+    sql = ("SELECT r_name, sum(amount) AS s FROM fact "
+           "JOIN region ON n_regionkey = r_regionkey "
+           "GROUP BY r_name ORDER BY r_name")
+    got = eng.sql(sql)
+    assert eng.last_plan.rewritten, eng.last_plan.fallback_reason
+    pd.testing.assert_frame_equal(got, _expected(fact), check_dtype=False)
+
+
+def test_unsubsumed_chain_falls_back():
+    """No FD, no nation join: the region link is underivable; the plan
+    must NOT rewrite (negative test for join subsumption)."""
+    eng, _ = _fixture(with_fd=False)
+    sql = ("SELECT r_name, sum(amount) AS s FROM fact "
+           "JOIN region ON n_regionkey = r_regionkey "
+           "GROUP BY r_name ORDER BY r_name")
+    plan = eng.planner.plan(sql)
+    assert not plan.rewritten
+    assert "not subsumed" in plan.fallback_reason
+
+
+def test_non_fk_join_condition_falls_back():
+    eng, _ = _fixture(with_fd=False)
+    sql = ("SELECT r_name, sum(amount) AS s FROM fact "
+           "JOIN region ON c_city = r_name GROUP BY r_name")
+    plan = eng.planner.plan(sql)
+    assert not plan.rewritten
+    assert "no FK join condition" in plan.fallback_reason
+
+
+def test_ssb_nation_region_chain_variant():
+    """SSB-variant acceptance (VERDICT r1 #5 'done' condition): the bench
+    fixture's supplier chain s_city → s_nation → s_region expressed as
+    normalized snowflake tables rewrites onto the denormalized fact."""
+    from tpu_olap.bench.ssb import generate_tables, denormalize, TIME_COL
+    tables = generate_tables(8000, seed=3)
+    denorm = denormalize(tables)
+    sup = tables["supplier"]
+    nation_t = (sup[["s_nation"]].drop_duplicates()
+                .rename(columns={"s_nation": "sn_name"}))
+    nation_t["sn_regionkey"] = pd.factorize(
+        sup.drop_duplicates("s_nation")["s_region"])[0]
+    region_map = (sup[["s_nation", "s_region"]].drop_duplicates("s_nation"))
+    key_of = dict(zip(nation_t.sn_name, nation_t.sn_regionkey))
+    region_t = pd.DataFrame({
+        "sr_key": [key_of[n] for n in region_map.s_nation],
+        "sr_name": list(region_map.s_region),
+    }).drop_duplicates("sr_key")
+    star = StarSchema(
+        fact="lineorder",
+        dimensions=(
+            StarDimension("nation", fact_key="s_nation", dim_key="sn_name",
+                          column_map={"sn_name": "s_nation"}),
+            StarDimension("region", fact_key="sn_regionkey",
+                          dim_key="sr_key",
+                          column_map={"sr_name": "s_region"}),
+        ))
+    eng = Engine()
+    eng.register_table("lineorder", denorm, time_column=TIME_COL,
+                       star_schema=star)
+    eng.register_table("nation", nation_t, accelerate=False)
+    eng.register_table("region", region_t, accelerate=False)
+    got = eng.sql(
+        "SELECT sr_name, sum(lo_revenue) AS rev FROM lineorder "
+        "JOIN nation ON s_nation = sn_name "
+        "JOIN region ON sn_regionkey = sr_key "
+        "GROUP BY sr_name ORDER BY sr_name")
+    assert eng.last_plan.rewritten, eng.last_plan.fallback_reason
+    exp = (denorm.groupby("s_region", as_index=False)
+           .agg(rev=("lo_revenue", "sum"))
+           .rename(columns={"s_region": "sr_name"})
+           .sort_values("sr_name").reset_index(drop=True))
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)

@@ -1,0 +1,205 @@
+"""SF100-shaped data path (SURVEY.md §8.4 #4, BASELINE.json:5 "streams
+Parquet→HBM"): row-group streaming ingest, multi-file datasets, narrow
+int storage, incremental sorted dictionaries, and the HBM budget with LRU
+column eviction."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from tpu_olap import Engine
+from tpu_olap.executor import EngineConfig
+from tpu_olap.segments.dictionary import Dictionary
+from tpu_olap.segments.ingest import (DictBuilder, _int_dtype_for,
+                                      ingest_pandas, ingest_parquet_stream)
+
+
+def _frame(n, seed, t0="2022-01-01"):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "ts": pd.to_datetime(t0)
+        + pd.to_timedelta(rng.integers(0, 86400 * 25, n), unit="s"),
+        "city": rng.choice(["ams", "ber", "cdg", "dub", "edi"], n),
+        "status": rng.choice(["ok", "err"], n),
+        "qty": rng.integers(0, 90, n).astype(np.int64),         # int8 range
+        "price": rng.integers(100, 20000, n).astype(np.int64),  # int16 range
+        "wide": rng.integers(0, 10**10, n).astype(np.int64),    # int64 only
+        "ratio": rng.random(n),
+    })
+
+
+# ---------------------------------------------------------------- unit
+
+def test_int_dtype_selection():
+    assert _int_dtype_for(0, 90) == np.int8
+    assert _int_dtype_for(-100, 100) == np.int8
+    assert _int_dtype_for(0, 200) == np.int16
+    assert _int_dtype_for(-40000, 0) == np.int32
+    assert _int_dtype_for(0, 2**40) == np.int64
+    # most-negative value of each dtype stays free (sentinel convention)
+    assert _int_dtype_for(-128, 0) == np.int16
+    assert _int_dtype_for(np.iinfo(np.int32).min, 0) == np.int64
+
+
+def test_dict_builder_matches_batch_build():
+    """Incremental encode + finalize remap == one-shot sorted build."""
+    rng = np.random.default_rng(0)
+    vals = rng.choice(["pear", "apple", "fig", "kiwi", None], 5000)
+    vals = np.asarray(vals, dtype=object)
+    ref_dict, ref_codes = Dictionary.build(vals)
+
+    b = DictBuilder()
+    parts = [b.encode(vals[i:i + 700]) for i in range(0, 5000, 700)]
+    d, remap = b.finalize()
+    codes = remap[np.concatenate(parts)]
+    assert list(d.values) == list(ref_dict.values)
+    np.testing.assert_array_equal(codes, ref_codes)
+
+
+def test_dict_builder_null_only_empty_string():
+    b = DictBuilder()
+    c1 = b.encode(np.array([None, "x", None], dtype=object))
+    c2 = b.encode(np.array(["", "x"], dtype=object))
+    d, remap = b.finalize()
+    assert list(d.values) == ["", "x"]   # real "" kept, null-only "" never
+    np.testing.assert_array_equal(remap[c1], [0, 2, 0])
+    np.testing.assert_array_equal(remap[c2], [1, 2])
+
+
+def test_narrow_storage_dtypes():
+    t = ingest_pandas("t", _frame(3000, 1), time_column="ts", block_rows=512)
+    s0 = t.segments[0]
+    assert s0.columns["qty"].dtype == np.int8
+    assert s0.columns["price"].dtype == np.int16
+    assert s0.columns["wide"].dtype == np.int64
+    assert s0.columns["city"].dtype == np.int8    # 5 values
+    assert s0.columns["ratio"].dtype == np.float64
+    assert s0.columns["__time"].dtype == np.int64
+    # all segments share the global dtype (stacking stays uniform)
+    assert all(s.columns["price"].dtype == np.int16 for s in t.segments)
+
+
+# ------------------------------------------------------------ streaming
+
+@pytest.fixture()
+def multi_file(tmp_path):
+    """Three parquet files with several row groups each."""
+    frames = [_frame(4000, seed, t0)
+              for seed, t0 in ((1, "2022-01-01"), (2, "2022-02-01"),
+                               (3, "2022-03-01"))]
+    paths = []
+    for i, f in enumerate(frames):
+        p = str(tmp_path / f"part{i}.parquet")
+        pq.write_table(pa.Table.from_pandas(f, preserve_index=False), p,
+                       row_group_size=900)
+        paths.append(p)
+    return paths, pd.concat(frames, ignore_index=True)
+
+
+SQLS = [
+    "SELECT city, sum(qty) AS s, count(*) AS n FROM t "
+    "GROUP BY city ORDER BY city",
+    "SELECT status, sum(price) AS p, min(wide) AS w FROM t "
+    "GROUP BY status ORDER BY status",
+    "SELECT sum(qty*price) AS v FROM t WHERE qty < 25",
+]
+
+
+def test_multi_file_streaming_parity(multi_file):
+    paths, whole = multi_file
+    eng = Engine()
+    eng.register_table("t", paths, time_column="ts")
+    ref = Engine()
+    ref.register_table("t", whole, time_column="ts")
+    for q in SQLS:
+        got, exp = eng.sql(q), ref.sql(q)
+        assert eng.last_plan.rewritten
+        pd.testing.assert_frame_equal(got, exp)
+
+
+def test_streaming_batches_bounded(multi_file):
+    """iter_batches path: tiny batch size exercises the carry/flush
+    logic; segment time ranges stay exact for pruning."""
+    paths, whole = multi_file
+    t = ingest_parquet_stream("t", paths, time_column="ts",
+                              block_rows=1024, batch_rows=333)
+    assert t.num_rows == len(whole)
+    for s in t.segments:
+        if s.meta.n_valid:
+            tv = s.columns["__time"][:s.meta.n_valid].astype(np.int64)
+            assert tv.min() == s.meta.time_min
+            assert tv.max() == s.meta.time_max
+    # dictionary is sorted (bound filters rely on it)
+    d = t.dictionaries["city"]
+    assert list(d.values) == sorted(d.values)
+
+
+def test_streaming_interval_pruning(multi_file):
+    """Month-disjoint files must prune to ~1/3 of segments."""
+    paths, whole = multi_file
+    eng = Engine()
+    eng.register_table("t", paths, time_column="ts", block_rows=1024)
+    got = eng.sql("SELECT sum(qty) AS s FROM t "
+                  "WHERE ts >= '2022-03-01' AND ts < '2022-04-01'")
+    m = whole[whole.ts >= "2022-03-01"]
+    assert int(got.s[0]) == int(m.qty.sum())
+    h = eng.history[-1]
+    assert h["segments_scanned"] < h["segments_total"] / 2
+
+
+def test_schema_mismatch_across_files(tmp_path):
+    a = str(tmp_path / "a.parquet")
+    b = str(tmp_path / "b.parquet")
+    pd.DataFrame({"x": [1, 2]}).to_parquet(a)
+    pd.DataFrame({"y": [1.0]}).to_parquet(b)
+    with pytest.raises(ValueError, match="schema mismatch"):
+        ingest_parquet_stream("t", [a, b])
+
+
+def test_empty_table_finalize():
+    ing_df = pd.DataFrame({"ts": pd.to_datetime([]), "g": pd.Series([], dtype=str),
+                           "v": pd.Series([], dtype=np.int64)})
+    t = ingest_pandas("t", ing_df, time_column="ts")
+    assert t.num_rows == 0
+    assert "g" in t.dictionaries
+
+
+# ------------------------------------------------------------ HBM budget
+
+def test_hbm_budget_lru_eviction():
+    df = _frame(6000, 7)
+    eng = Engine(EngineConfig(hbm_budget_bytes=1))  # evict everything else
+    eng.register_table("t", df, time_column="ts", block_rows=1024)
+    eng.sql("SELECT city, sum(qty) AS s FROM t GROUP BY city")
+    eng.sql("SELECT status, sum(price) AS p FROM t GROUP BY status")
+    led = eng.runner._hbm_ledger
+    assert led.evictions > 0
+    # correctness survives eviction: re-run the first query
+    got = eng.sql("SELECT city, sum(qty) AS s FROM t "
+                  "GROUP BY city ORDER BY city")
+    exp = df.groupby("city", as_index=False).agg(s=("qty", "sum"))
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+    assert eng.history[-1]["hbm_evictions"] > 0
+
+
+def test_hbm_budget_pins_working_set():
+    """Within one query, the env build must not evict its own columns."""
+    df = _frame(4000, 8)
+    eng = Engine(EngineConfig(hbm_budget_bytes=1))
+    eng.register_table("t", df, time_column="ts", block_rows=1024)
+    got = eng.sql("SELECT city, status, sum(qty) AS s, sum(price) AS p, "
+                  "max(wide) AS w FROM t GROUP BY city, status "
+                  "ORDER BY city, status")
+    exp = (df.groupby(["city", "status"], as_index=False)
+           .agg(s=("qty", "sum"), p=("price", "sum"), w=("wide", "max")))
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+def test_unbudgeted_ledger_keeps_all():
+    df = _frame(3000, 9)
+    eng = Engine()
+    eng.register_table("t", df, time_column="ts", block_rows=1024)
+    eng.sql("SELECT city, sum(qty) AS s FROM t GROUP BY city")
+    assert eng.runner._hbm_ledger.evictions == 0

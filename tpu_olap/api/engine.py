@@ -19,7 +19,8 @@ from tpu_olap.kernels.timebucket import UnsupportedGranularity
 from tpu_olap.planner import DruidPlanner
 from tpu_olap.planner.fallback import FallbackError, execute_fallback
 from tpu_olap.segments.ingest import (DEFAULT_BLOCK_ROWS, ingest_arrow,
-                                      ingest_pandas, ingest_parquet)
+                                      ingest_pandas, ingest_parquet,
+                                      ingest_parquet_stream)
 
 _UNSUPPORTED = (UnsupportedAggregation, UnsupportedFilter,
                 UnsupportedGranularity, UnsupportedDimension)
@@ -48,15 +49,17 @@ class Engine:
                        column_map: dict | None = None,
                        columns=None, **options):
         """Register a datasource. `data`: pandas DataFrame, pyarrow Table,
-        or parquet path. accelerate=False registers a plain (dimension)
-        table served only by the fallback path — the reference's
-        non-druid-backed relation.
+        parquet path, or a list of parquet paths (a multi-file dataset).
+        accelerate=False registers a plain (dimension) table served only
+        by the fallback path — the reference's non-druid-backed relation.
 
-        Parquet/Arrow inputs ingest straight from the Arrow columns (no
-        pandas detour) and the fallback DataFrame materializes lazily on
-        first fallback use. `columns` optionally prunes the ingested
-        column set — always POST-rename names (after column_map), for
-        every input type; parquet reads skip pruned columns entirely.
+        Parquet inputs stream row-group batches into segments under
+        bounded host memory (SURVEY.md §8.4 #4); Arrow inputs ingest
+        straight from the Arrow columns (no pandas detour); the fallback
+        DataFrame materializes lazily on first fallback use. `columns`
+        optionally prunes the ingested column set — always POST-rename
+        names (after column_map), for every input type; parquet reads
+        skip pruned columns entirely.
         """
         column_map = dict(column_map) if column_map else None
         if column_map and time_column in column_map:
@@ -68,19 +71,28 @@ class Engine:
                     [column_map.get(c, c) for c in tbl.schema.names])
             return tbl
 
-        if isinstance(data, str):
+        segments = None
+        if isinstance(data, str) or (
+                isinstance(data, (list, tuple))
+                and all(isinstance(p, str) for p in data)):
             import pyarrow.parquet as pq
-            path = data
+            paths = [data] if isinstance(data, str) else list(data)
             inverse = {v: k for k, v in (column_map or {}).items()}
             read_cols = [inverse.get(c, c) for c in columns] \
                 if columns else None
 
-            def load_frame(_path=path, _cols=read_cols):
-                f = pq.read_table(_path, columns=_cols).to_pandas()
+            def load_frame(_paths=tuple(paths), _cols=read_cols):
+                f = pd.concat(
+                    [pq.read_table(p, columns=_cols).to_pandas()
+                     for p in _paths], ignore_index=True) \
+                    if len(_paths) > 1 else \
+                    pq.read_table(_paths[0], columns=_cols).to_pandas()
                 return f.rename(columns=column_map) if column_map else f
 
-            table = _renamed_arrow(pq.read_table(path, columns=read_cols)) \
-                if accelerate else None
+            if accelerate:
+                segments = ingest_parquet_stream(
+                    name, paths, time_column, block_rows,
+                    columns=columns, column_map=column_map)
             frame_source = load_frame
         elif isinstance(data, pd.DataFrame):
             frame = data.copy()
@@ -100,8 +112,7 @@ class Engine:
             def frame_source(_t=table):
                 return _t.to_pandas()
 
-        segments = None
-        if accelerate:
+        if accelerate and segments is None:
             segments = ingest_arrow(name, table, time_column, block_rows)
         star = star_schema
         if isinstance(star, dict):

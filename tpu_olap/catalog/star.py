@@ -51,6 +51,23 @@ class StarSchema:
             return False
         return {left, right} == {d.fact_key, d.dim_key}
 
+    def fd_closure(self, cols: set) -> set:
+        """Closure of a column set under the declared functional
+        dependencies: every column transitively determined by `cols`.
+        The planner uses this to validate snowflake chain joins whose
+        linking column is implied rather than materialized (SURVEY.md
+        §3.2 JoinTransform: 'join keys = declared FK paths, functional
+        dependencies')."""
+        out = set(cols)
+        changed = True
+        while changed:
+            changed = False
+            for fd in self.functional_dependencies:
+                if fd.determinant in out and fd.dependent not in out:
+                    out.add(fd.dependent)
+                    changed = True
+        return out
+
     @staticmethod
     def from_json(j: dict) -> "StarSchema":
         dims = tuple(

@@ -26,15 +26,31 @@ class EngineConfig:
     # theta sketch nominal-entries cap (k × groups × 8B of HBM)
     theta_k_cap: int = 1 << 14
 
+    # host-side label-table cap per grouped NUMERIC dimension (the dense
+    # id space materializes [size] labels at lowering time; this bounds
+    # host memory, not the group space — the sparse path groups far past
+    # the dense budget through the same per-dim id spaces)
+    numeric_dim_label_budget: int = 1 << 22
+
     # sort-based sparse group-by (kernels.sparse_groupby), used when the
     # dense mixed-radix space exceeds dense_group_budget: initial compact
     # table size (adapts upward pow2 on overflow) and the hard ceiling of
     # PRESENT groups before the query is declared non-rewritable.
     sparse_group_cap: int = 1 << 15
     sparse_group_budget: int = 1 << 21
+    # multi-chip sparse merge strategy: "exchange" = hash-partitioned
+    # all_to_all (present groups scale with chip count: capacity is
+    # D x sparse_group_budget when keys distribute), "gather" = legacy
+    # all-gather-everything (every chip re-merges all D tables).
+    sparse_merge: str = "exchange"
 
     # segments per device dispatch (flattened rows = batch × block_rows)
     max_segments_per_dispatch: int = 1 << 10
+
+    # HBM residency budget (bytes) for device-cached column buffers across
+    # all tables; least-recently-used columns evict when exceeded
+    # (SURVEY.md §8.4 #4). None = unbounded (single-table dev default).
+    hbm_budget_bytes: int | None = None
 
     # packed results: max non-empty groups shipped back per query in the
     # single-fetch compacted buffer (executor.packing). Queries whose

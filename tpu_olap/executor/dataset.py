@@ -9,11 +9,55 @@ masked segments cost compute but no transfer — the dense-scan tradeoff).
 
 from __future__ import annotations
 
+from collections import OrderedDict
+
 import numpy as np
 
 from tpu_olap.segments.segment import ColumnType, TableSegments, TIME_COLUMN
 
-_I32_MIN, _I32_MAX = np.iinfo(np.int32).min + 1, np.iinfo(np.int32).max
+
+class HbmLedger:
+    """LRU accounting of device-resident column buffers across every
+    table the runner serves (SURVEY.md §8.4 #4: "v5e-8 HBM budget forces
+    column discipline"). When an upload would exceed the budget, the
+    least-recently-used unpinned buffers are evicted first; buffers the
+    in-flight query needs are pinned for the duration of its env build.
+    A single over-budget column still uploads (the query must run) —
+    the budget bounds the cache, not one query's working set."""
+
+    def __init__(self, budget_bytes: int | None):
+        self.budget = budget_bytes
+        self._entries: OrderedDict[tuple, tuple[int, object]] = \
+            OrderedDict()  # key -> (nbytes, evict_fn)
+        self.bytes_in_use = 0
+        self.evictions = 0
+
+    def touch(self, key):
+        if key in self._entries:
+            self._entries.move_to_end(key)
+
+    def add(self, key, nbytes: int, evict_fn, pinned=frozenset()):
+        if self.budget is not None:
+            for k in list(self._entries):
+                if self.bytes_in_use + nbytes <= self.budget:
+                    break
+                if k in pinned:
+                    continue
+                n, fn = self._entries.pop(k)
+                self.bytes_in_use -= n
+                self.evictions += 1
+                fn()
+        self._entries[key] = (nbytes, evict_fn)
+        self.bytes_in_use += nbytes
+
+    def remove(self, key):
+        e = self._entries.pop(key, None)
+        if e is not None:
+            self.bytes_in_use -= e[0]
+
+    def remove_table(self, table_name: str):
+        for k in [k for k in self._entries if k[0] == table_name]:
+            self.remove(k)
 
 
 class DeviceDataset:
@@ -25,10 +69,11 @@ class DeviceDataset:
     """
 
     def __init__(self, table: TableSegments, platform: str = "device",
-                 mesh=None):
+                 mesh=None, ledger: HbmLedger | None = None):
         self.table = table
         self.platform = platform
         self.mesh = mesh
+        self.ledger = ledger
         self._cols: dict[str, object] = {}
         self._nulls: dict[str, object] = {}
         self._valid = None
@@ -57,13 +102,16 @@ class DeviceDataset:
         return np.stack(rows)
 
     def _narrow_dtype(self, name: str):
-        """int32 for LONG columns whose values all fit (per the segment
-        manifest's column min/max) — halves HBM residency and scan
-        bandwidth; sums still widen to the accumulator dtype on device.
-        __time stays int64 (epoch millis exceed int32)."""
+        """Smallest int dtype (int8/int16/int32/int64) holding every
+        value of a LONG column per the segment manifest's column min/max
+        — 2-8x less HBM residency and scan bandwidth; sums still widen
+        to the accumulator dtype on device. Usually a no-op cast: ingest
+        already stores the narrowed dtype. __time stays int64 (epoch
+        millis exceed int32)."""
         if name == TIME_COLUMN or \
                 self.table.schema.get(name) is not ColumnType.LONG:
             return None
+        from tpu_olap.segments.ingest import _int_dtype_for
         lo = hi = None
         for s in self.table.segments:
             mlo = s.meta.column_min.get(name)
@@ -72,31 +120,47 @@ class DeviceDataset:
                 continue  # empty/all-null segment stores zero fill
             lo = mlo if lo is None else min(lo, mlo)
             hi = mhi if hi is None else max(hi, mhi)
-        if lo is None or (lo >= _I32_MIN and hi <= _I32_MAX):
-            return np.int32
-        return None
+        if lo is None:
+            return np.dtype(np.int8)
+        return _int_dtype_for(lo, hi)
 
-    def col(self, name: str):
+    def _ledger_add(self, kind: str, name: str, arr, pinned):
+        if self.ledger is None:
+            return
+        key = (self.table.name, kind, name)
+        nbytes = int(np.prod(self.shape)) * np.dtype(arr.dtype).itemsize \
+            if arr.dtype != bool else int(np.prod(self.shape))
+        store = self._cols if kind == "col" else self._nulls
+        self.ledger.add(key, nbytes, lambda: store.pop(name, None), pinned)
+
+    def col(self, name: str, pinned=frozenset()):
         if name not in self._cols:
             dt = self._narrow_dtype(name)
             get = (lambda s: s.columns[name]) if dt is None else \
-                (lambda s: s.columns[name].astype(dt))
+                (lambda s: s.columns[name].astype(dt, copy=False))
             self._cols[name] = self._put(self._stack(get))
+            self._ledger_add("col", name, self._cols[name], pinned)
+        elif self.ledger is not None:
+            self.ledger.touch((self.table.name, "col", name))
         return self._cols[name]
 
-    def null_mask(self, name: str):
+    def null_mask(self, name: str, pinned=frozenset()):
         """None if the column has no nulls anywhere."""
         if name not in self._nulls:
             if any(name in s.null_masks for s in self.table.segments):
                 zero = np.zeros(self.table.block_rows, bool)
                 self._nulls[name] = self._put(
                     self._stack(lambda s: s.null_masks.get(name, zero)))
+                self._ledger_add("null", name, self._nulls[name], pinned)
             else:
                 self._nulls[name] = None
+        elif self.ledger is not None and self._nulls[name] is not None:
+            self.ledger.touch((self.table.name, "null", name))
         return self._nulls[name]
 
     def valid(self):
-        """[S, R] row-validity (padding rows/segments are False)."""
+        """[S, R] row-validity (padding rows/segments are False).
+        Never ledgered: every query needs it and it is 1 byte/row."""
         if self._valid is None:
             r = np.arange(self.table.block_rows)
             self._valid = self._put(
@@ -110,14 +174,21 @@ class DeviceDataset:
         return m
 
     def env(self, columns, null_cols):
-        """Build the kernel env for the requested columns."""
+        """Build the kernel env for the requested columns. The whole
+        working set is pinned while it builds so budget eviction cannot
+        drop a column this same query is about to use."""
+        pinned = frozenset(
+            [(self.table.name, "col", c) for c in columns]
+            + [(self.table.name, "null", c) for c in null_cols])
         return {
-            "cols": {c: self.col(c) for c in columns},
+            "cols": {c: self.col(c, pinned) for c in columns},
             "nulls": {c: m for c in null_cols
-                      if (m := self.null_mask(c)) is not None},
+                      if (m := self.null_mask(c, pinned)) is not None},
         }
 
     def evict(self):
         self._cols.clear()
         self._nulls.clear()
         self._valid = None
+        if self.ledger is not None:
+            self.ledger.remove_table(self.table.name)

@@ -167,7 +167,8 @@ def _lower_agg(query, table, config) -> PhysicalPlan:
     else:
         dim_specs = ()
     dim_plans = [compile_dimension(s, table, pool, t_min, t_max,
-                                   numeric_dim_budget=config.dense_group_budget)
+                                   numeric_dim_budget=config
+                                   .numeric_dim_label_budget)
                  for s in dim_specs]
 
     agg_plans = compile_aggregations(

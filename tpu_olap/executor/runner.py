@@ -61,6 +61,8 @@ class QueryRunner:
                 "num_shards > 1 requires the jax device platform; the "
                 "numpy path ('cpu') is single-shard by construction")
         self._datasets: dict = {}
+        from tpu_olap.executor.dataset import HbmLedger
+        self._hbm_ledger = HbmLedger(self.config.hbm_budget_bytes)
         self._jit_cache: dict = {}
         self._arg_cache: dict = {}   # uploaded consts/seg-mask, content-keyed
         self._cap_hints: dict = {}   # template -> last observed group count
@@ -220,7 +222,8 @@ class QueryRunner:
         key = table.name
         ds = self._datasets.get(key)
         if ds is None or ds.table is not table:
-            ds = DeviceDataset(table, self.config.platform, self.mesh)
+            ds = DeviceDataset(table, self.config.platform, self.mesh,
+                               self._hbm_ledger)
             self._datasets[key] = ds
         return ds
 
@@ -237,6 +240,9 @@ class QueryRunner:
         metrics["rows_scanned"] = int(sum(
             table.segments[i].meta.n_valid for i in plan.pruned_ids)) \
             if not plan.empty else 0
+        if self._hbm_ledger.budget is not None:
+            metrics["hbm_bytes"] = self._hbm_ledger.bytes_in_use
+            metrics["hbm_evictions"] = self._hbm_ledger.evictions
         return env, valid, seg_mask
 
     def _run_partials(self, plan: PhysicalPlan, metrics: dict) -> dict:
@@ -369,17 +375,29 @@ class QueryRunner:
 
     def _run_sparse(self, plan: PhysicalPlan, metrics: dict):
         """Sort-based sparse group-by dispatch with adaptive compact-table
-        cap (kernels.sparse_groupby). Returns (partials dict, count)."""
+        cap (kernels.sparse_groupby). Multi-chip merge strategy per
+        EngineConfig.sparse_merge: "exchange" hash-partitions compacted
+        entries to key-owner chips over all_to_all (capacity scales
+        D × budget); "gather" all-gathers every chip's table. Returns
+        (partials dict, count); exchange partial arrays are [D·cap_owner]
+        slot tables (SENTINEL-keyed empties), others are [cap] compacts."""
         from tpu_olap.kernels.groupby import UnsupportedAggregation
 
         env, valid, seg_mask = self._prepare(plan, metrics)
         mesh = self.mesh
         n_shards = mesh.devices.size if mesh else 1
         base_key = plan.fingerprint() + ("sparse", n_shards)
-        cap_limit = min(self.config.sparse_group_budget, plan.total_groups)
+        use_exchange = mesh is not None and n_shards > 1 and \
+            self.config.sparse_merge == "exchange"
+        budget = self.config.sparse_group_budget
+        # exchange scales global capacity with the mesh; local compaction
+        # and per-owner tables each stay within the per-chip budget
+        cap_limit = min(budget * (n_shards if use_exchange else 1),
+                        plan.total_groups)
+        local_limit = min(budget, plan.total_groups)
         hint = self._cap_hints.get(base_key)
-        cap = min(cap_limit, self.config.sparse_group_cap) if hint is None \
-            else min(cap_limit, max(64, _next_pow2(2 * hint)))
+        cap = min(local_limit, self.config.sparse_group_cap) \
+            if hint is None else min(local_limit, max(64, _next_pow2(2 * hint)))
 
         t0 = time.perf_counter()
         hit = False
@@ -397,7 +415,7 @@ class QueryRunner:
                 cap = min(cap_limit, _next_pow2(count))
             out = {k: np.asarray(v) for k, v in out.items()}
             metrics["num_shards"] = 1
-        else:
+        elif not use_exchange:
             import jax
             consts_dev, seg_arg = self._args_for(plan, seg_mask, mesh)
             while True:
@@ -408,8 +426,8 @@ class QueryRunner:
                     kern = plan.make_sparse_kernel(cap)
                     if mesh is not None:
                         from tpu_olap.executor.sharding import \
-                            sharded_sparse_kernel
-                        jitted = jax.jit(sharded_sparse_kernel(
+                            sharded_sparse_gather_kernel
+                        jitted = jax.jit(sharded_sparse_gather_kernel(
                             kern, plan, mesh, cap))
                     else:
                         jitted = jax.jit(kern)
@@ -425,6 +443,58 @@ class QueryRunner:
                 cap = min(cap_limit, _next_pow2(count))
             out = {k: np.asarray(v) for k, v in out.items()}
             metrics["num_shards"] = n_shards
+        else:
+            import jax
+            from tpu_olap.executor.sharding import \
+                sharded_sparse_exchange_kernel
+            consts_dev, seg_arg = self._args_for(plan, seg_mask, mesh)
+            lhint = self._cap_hints.get(base_key + ("local",))
+            if lhint is not None:
+                cap = min(local_limit, max(64, _next_pow2(2 * lhint)))
+            ohint = self._cap_hints.get(base_key + ("owner",))
+            cap_owner = max(64, _next_pow2(2 * ohint)) if ohint \
+                else max(64, _next_pow2(-(-2 * cap // n_shards)))
+            cap_owner = min(cap_owner, budget)
+            while True:
+                key = base_key + ("x", cap, cap_owner)
+                jitted = self._jit_cache.get(key)
+                hit = jitted is not None
+                if not hit:
+                    kern = plan.make_sparse_kernel(cap)
+                    jitted = jax.jit(sharded_sparse_exchange_kernel(
+                        kern, plan, mesh, cap, cap_owner))
+                    self._jit_cache[key] = jitted
+                out = jitted(env, valid, seg_arg, consts_dev)
+                count = int(out["_count"])
+                local_max = int(out["_local_max"])
+                overflow = int(out["_overflow"])
+                retry = False
+                if local_max > cap:
+                    if local_max > local_limit:
+                        raise UnsupportedAggregation(
+                            f"{local_max} per-chip present groups exceed "
+                            f"sparse budget {local_limit}")
+                    cap = min(local_limit, _next_pow2(local_max))
+                    retry = True
+                if overflow:
+                    new_owner = min(budget, _next_pow2(max(
+                        2 * max(count, 1) // n_shards, 2 * cap_owner)))
+                    if new_owner == cap_owner:  # already at the clamp
+                        raise UnsupportedAggregation(
+                            f"owner tables overflow the per-chip sparse "
+                            f"budget {budget} ({count}+ present groups "
+                            f"over {n_shards} chips)")
+                    cap_owner = new_owner
+                    retry = True
+                if not retry:
+                    break
+            out = {k: np.asarray(v) for k, v in out.items()}
+            self._cap_hints[base_key + ("local",)] = local_max
+            self._cap_hints[base_key + ("owner",)] = \
+                max(64, count // n_shards)
+            metrics["num_shards"] = n_shards
+            metrics["sparse_merge"] = "exchange"
+            metrics["result_cap_owner"] = cap_owner
         self._cap_hints[base_key] = count
         metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
         metrics["cache_hit"] = hit
@@ -443,14 +513,19 @@ class QueryRunner:
         specs = agg_specs_by_name(query.aggregations)
 
         if plan.sparse:
+            from tpu_olap.kernels.sparse_groupby import SENTINEL
             out, count = self._dispatch(
                 lambda: self._run_sparse(plan, metrics), metrics, table.name)
             t0 = time.perf_counter()
             arrays = finalize_aggs(out, plan.agg_plans, specs)
             eval_post_aggs(arrays, query.post_aggregations)
             names = self._out_names(query)
-            present = out["_keys"][:count].astype(np.int64)
-            sub = {n: np.asarray(arrays[n])[:count] for n in names}
+            # present groups by sentinel mask: compact tables fill the
+            # tail with SENTINEL; exchange slot tables interleave empties
+            keys = np.asarray(out["_keys"])
+            pm = keys != SENTINEL
+            present = keys[pm].astype(np.int64)
+            sub = {n: np.asarray(arrays[n])[pm] for n in names}
             res = self._emit_groupby(query, plan, present, sub)
             res.metrics = metrics
             metrics["assemble_ms"] = (time.perf_counter() - t0) * 1000

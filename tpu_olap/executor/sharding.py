@@ -12,8 +12,14 @@ aggregates allreduce over ICI").
 The dense group table is what makes this an allreduce instead of a hash
 exchange: group ids are global (dictionary codes × calendar buckets), so no
 chip ever needs another chip's rows — only its [K] table. High-cardinality
-GROUP BY beyond the dense budget falls back (SURVEY.md §8.4 #1); a
-hash-exchange path is future work.
+GROUP BY beyond the dense budget takes the sparse (sort-based) path, whose
+multi-chip merge is a **hash exchange** (SURVEY.md §3.5 last row, §8.4 #1):
+each chip compacts its local groups, entries route to a key-hash owner chip
+over an ICI all_to_all, and each owner merges only its own keys — so
+present-group capacity scales with chip count (D × per-chip budget when
+keys distribute) and per-chip merge work stays O(global/D), unlike the
+legacy gather-everything strategy (sharded_sparse_gather_kernel, kept as
+EngineConfig.sparse_merge="gather").
 """
 
 from __future__ import annotations
@@ -101,12 +107,12 @@ def sharded_kernel(plan, mesh: Mesh):
     return run
 
 
-def sharded_sparse_kernel(kernel, plan, mesh: Mesh, cap: int):
-    """Sparse (sort-based) group-by over the mesh: each chip reduces its
-    local segments to a compacted [cap] table, tables all_gather over ICI
-    ([D, cap] is small), and every chip re-merges by key — the sparse
-    analog of merge_collective (SURVEY.md §3.5 P2 with compaction standing
-    in for the dense-table allreduce)."""
+def sharded_sparse_gather_kernel(kernel, plan, mesh: Mesh, cap: int):
+    """Legacy sparse merge: each chip reduces its local segments to a
+    compacted [cap] table, tables all_gather over ICI, and every chip
+    re-merges the full [D, cap] concatenation. Simple and fine for small
+    D·cap; superseded by the hash exchange below for scale (every chip
+    pays O(D·cap) transfer + re-sort, and cap must hold ALL groups)."""
     from tpu_olap.kernels.sparse_groupby import merge_sparse
 
     agg_plans = plan.agg_plans
@@ -132,6 +138,118 @@ def sharded_sparse_kernel(kernel, plan, mesh: Mesh, cap: int):
                       jax.tree.map(lambda _: P(), consts)),
             out_specs=P(),
             check_vma=False,  # replicated by construction post-gather
+        )
+        return f(env, valid, seg_mask, consts)
+
+    return run
+
+
+def bucket_cap(cap_local: int, num_shards: int) -> int:
+    """Send-bucket slots per destination chip: expected load is
+    cap_local/D under a uniform key hash; 2x headroom absorbs skew."""
+    return max(64, -(-2 * cap_local // num_shards))
+
+
+def _owner_of(keys, num_shards: int, jnp):
+    """Key-hash owner chip (Fibonacci multiplicative hash over the int64
+    mixed-radix key; the multiplier is 2^64/φ as a signed int64)."""
+    h = keys * jnp.int64(-7046029254386353131)
+    h = (h >> jnp.int64(33)) & jnp.int64(0x7FFFFFFF)
+    return (h % jnp.int64(num_shards)).astype(jnp.int32)
+
+
+def sharded_sparse_exchange_kernel(kernel, plan, mesh: Mesh,
+                                   cap_local: int, cap_owner: int):
+    """Hash-exchange sparse merge (SURVEY.md §3.5 last row; §8.4 #1;
+    PAPERS.md "partial partial aggregates" shape):
+
+      1. each chip compacts its local rows to a sorted [cap_local] group
+         table (the pre-aggregation — row counts never cross ICI);
+      2. every entry routes to owner = hash(key) % D: entries scatter
+         into a [D, B] send buffer (B = bucket_cap) and swap via ONE
+         lax.all_to_all over ICI — each chip transfers O(cap_local), not
+         O(D·cap) like the gather strategy;
+      3. each owner merges only its own keys into a [cap_owner] table —
+         per-chip merge work is O(global/D), and total capacity is
+         D × cap_owner: present-group cardinality scales with chip count.
+
+    Outputs stay sharded on the owner axis (the host reads [D·cap_owner]
+    slot arrays; empty slots carry SENTINEL keys). Scalars:
+    `_count` = true global distinct, `_local_max` = max per-chip local
+    distinct (sizes cap_local retries), `_overflow` = 1 if any send
+    bucket or owner table overflowed (sizes cap_owner retries).
+    """
+    from tpu_olap.kernels.sparse_groupby import SENTINEL, merge_sparse
+
+    D = mesh.devices.size
+    B = bucket_cap(cap_local, D)
+    agg_plans = plan.agg_plans
+
+    def local(env, valid, seg_mask, consts):
+        out = kernel(env, valid, seg_mask, consts)
+        keys = out["_keys"]
+        present = keys != SENTINEL
+        owner = jnp.where(present, _owner_of(keys, D, jnp), D)
+
+        # rank of each entry within its owner bucket: stable sort by
+        # owner, then index minus a cummax of segment starts
+        idx = jnp.arange(cap_local, dtype=jnp.int32)
+        owner_s, order = jax.lax.sort((owner, idx), num_keys=1)
+        boundary = jnp.concatenate(
+            [jnp.ones((1,), bool), owner_s[1:] != owner_s[:-1]])
+        seg_start = jax.lax.cummax(jnp.where(boundary, idx, 0))
+        pos = jnp.zeros((cap_local,), jnp.int32) \
+            .at[order].set(idx - seg_start)
+
+        ok = present & (pos < B)
+        send_overflow = (present & (pos >= B)).sum(dtype=jnp.int32)
+        flat = jnp.where(ok, owner * B + jnp.minimum(pos, B - 1), D * B)
+
+        def scatter(v, fill):
+            buf = jnp.full((D * B + 1,) + v.shape[1:], fill, v.dtype)
+            buf = buf.at[flat].set(v, mode="drop")
+            return buf[:D * B].reshape((D, B) + v.shape[1:])
+
+        sent = {"_keys": scatter(keys, SENTINEL)}
+        for name, v in out.items():
+            if name in ("_keys", "_count"):
+                continue
+            sent[name] = scatter(v, np.zeros((), v.dtype))
+
+        recv = {name: jax.lax.all_to_all(v, DATA_AXIS, split_axis=0,
+                                         concat_axis=0, tiled=True)
+                for name, v in sent.items()}
+        parts = [{k: recv[k][d] for k in recv} for d in range(D)]
+        merged = merge_sparse(parts, agg_plans, cap_owner, jnp)
+
+        owner_count = merged["_count"]
+        merged["_count"] = jax.lax.psum(
+            jnp.minimum(owner_count, cap_owner), DATA_AXIS)
+        merged["_local_max"] = jax.lax.pmax(out["_count"], DATA_AXIS)
+        merged["_overflow"] = jax.lax.pmax(
+            ((owner_count > cap_owner) | (send_overflow > 0))
+            .astype(jnp.int32), DATA_AXIS)
+        return merged
+
+    def specs_like(env):
+        return {
+            "cols": {k: P(DATA_AXIS) for k in env["cols"]},
+            "nulls": {k: P(DATA_AXIS) for k in env["nulls"]},
+        }
+
+    def run(env, valid, seg_mask, consts):
+        scalar = {"_count", "_local_max", "_overflow"}
+        names = (["_keys", "_rows", "_count", "_local_max", "_overflow"]
+                 + [p.name for p in agg_plans]
+                 + [f"_nn_{p.name}" for p in agg_plans
+                    if p.kind in ("min", "max")])
+        f = jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(specs_like(env), P(DATA_AXIS), P(DATA_AXIS),
+                      jax.tree.map(lambda _: P(), consts)),
+            out_specs={n: (P() if n in scalar else P(DATA_AXIS))
+                       for n in names},
+            check_vma=False,
         )
         return f(env, valid, seg_mask, consts)
 

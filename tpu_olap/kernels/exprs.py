@@ -127,4 +127,13 @@ def _call(name, args, xp):
         return xp.minimum(args[0], args[1])
     if name in ("max", "greatest"):
         return xp.maximum(args[0], args[1])
+    if name == "cast_double":
+        return _as_float(args[0], xp)
+    if name == "cast_long":
+        x = args[0]
+        if hasattr(x, "dtype") and x.dtype.kind in "iu":
+            return x  # already integral
+        from tpu_olap.kernels.hashing import has_x64
+        it = xp.int64 if has_x64(xp) else xp.int32
+        return xp.trunc(x).astype(it)  # SQL casts truncate toward zero
     raise ValueError(f"unknown function {name!r} in expression")

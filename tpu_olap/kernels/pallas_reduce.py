@@ -278,7 +278,14 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool):
 
             env = {"cols": {}, "nulls": {}}
             for name, r in zip(col_names, col_refs):
-                env["cols"][name] = r[0, :]
+                # loads may be narrower than int32 (int8/int16 segment
+                # storage); compute in int32 — eligibility bounded every
+                # expression node to int32, narrower products would wrap
+                v = r[0, :]
+                if v.dtype != jnp.int32 and jnp.issubdtype(v.dtype,
+                                                           jnp.integer):
+                    v = v.astype(jnp.int32)
+                env["cols"][name] = v
             for name, r in zip(null_names, null_refs):
                 env["nulls"][name] = r[0, :]
             materialize_virtuals(vexprs, env["cols"], env["nulls"], jnp,

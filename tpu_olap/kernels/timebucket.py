@@ -153,6 +153,20 @@ def compile_time_format(fmt: str, tz: str, t_min: int, t_max: int, pool):
     import datetime as dt
     from zoneinfo import ZoneInfo
 
+    if fmt == "Q":
+        # quarter-of-year (1-4): no joda/strftime code exists, so the
+        # label renders directly from the P3M bucket starts
+        plan = compile_granularity(PeriodGranularity("P3M", tz), t_min,
+                                   t_max, pool)
+        zone = ZoneInfo(tz)
+        labels = [
+            str((dt.datetime.fromtimestamp(ms / 1000, tz=zone).month - 1)
+                // 3 + 1)
+            for ms in plan.starts]
+        values = sorted(set(labels))
+        index = {v: i for i, v in enumerate(values)}
+        remap = np.asarray([index[x] for x in labels], np.int32)
+        return plan, pool.add(remap), values
     period = format_finest_period(fmt)
     plan = compile_granularity(PeriodGranularity(period, tz), t_min, t_max,
                                pool)

@@ -37,28 +37,38 @@ def execute_fallback(stmt: SelectStmt, catalog, config) -> pd.DataFrame:
         # (segments are time-sorted, so unordered LIMIT picks the same rows)
         df = df.sort_values(time_col, kind="stable")
 
-    # joins (inner equi-joins; conditions from ON or WHERE)
+    # joins (inner equi-joins; conditions from ON or WHERE). Fixed point
+    # over the join list: a snowflake chain's parent may be listed after
+    # its child, and the link column only appears once the parent merges.
     where_conjs = _split_and(stmt.where)
-    for j in stmt.joins:
-        other = catalog.get(j.table).frame
-        conds = _split_and(j.on) if j.on is not None else where_conjs
-        pair = None
-        for c in conds:
-            p = _equi_pair(c, df.columns, other.columns)
-            if p:
-                pair = (c, p)
-                break
-        if pair is None:
-            raise FallbackError(f"no join condition for {j.table!r}")
-        cond, (lcol, rcol) = pair
-        if j.on is None:
-            where_conjs.remove(cond)
-        how = "left" if j.kind == "left" else "inner"
-        df = df.merge(other, left_on=lcol, right_on=rcol, how=how,
-                      suffixes=("", f"__{j.table}"))
-        if j.on is not None:
-            for extra in [c for c in _split_and(j.on) if c is not cond]:
-                df = df[_eval_bool(extra, df, time_col)]
+    pending = list(stmt.joins)
+    while pending:
+        still = []
+        for j in pending:
+            other = catalog.get(j.table).frame
+            conds = _split_and(j.on) if j.on is not None else where_conjs
+            pair = None
+            for c in conds:
+                p = _equi_pair(c, df.columns, other.columns)
+                if p:
+                    pair = (c, p)
+                    break
+            if pair is None:
+                still.append(j)
+                continue
+            cond, (lcol, rcol) = pair
+            if j.on is None:
+                where_conjs.remove(cond)
+            how = "left" if j.kind == "left" else "inner"
+            df = df.merge(other, left_on=lcol, right_on=rcol, how=how,
+                          suffixes=("", f"__{j.table}"))
+            if j.on is not None:
+                for extra in [c for c in _split_and(j.on) if c is not cond]:
+                    df = df[_eval_bool(extra, df, time_col)]
+        if len(still) == len(pending):
+            raise FallbackError(
+                f"no join condition for {still[0].table!r}")
+        pending = still
 
     for c in where_conjs:
         df = df[_eval_bool(c, df, time_col)]
@@ -337,6 +347,63 @@ def _eval(e, df, time_col):
                          and rx.fullmatch(str(x)) is not None)
         if fn == "abs":
             return _eval(e.args[0], df, time_col).abs()
+        if fn == "if":
+            c = _eval(e.args[0], df, time_col)
+            if hasattr(c, "fillna"):
+                c = c.fillna(False).astype(bool)
+            a = _eval(e.args[1], df, time_col)
+            b = _eval(e.args[2], df, time_col)
+            if not hasattr(a, "where"):
+                a = pd.Series([a] * len(df), index=df.index)
+            return a.where(c, b)
+        if fn == "cast_double":
+            v = _eval(e.args[0], df, time_col)
+            return pd.to_numeric(v, errors="raise").astype("Float64")
+        if fn == "cast_long":
+            v = pd.to_numeric(_eval(e.args[0], df, time_col),
+                              errors="raise")
+            arr = v.to_numpy(dtype="float64", na_value=np.nan)
+            tr = np.trunc(arr)  # SQL casts truncate toward zero
+            out = pd.array([pd.NA if np.isnan(x) else int(x) for x in tr],
+                           dtype="Int64")
+            return pd.Series(out, index=v.index)
+        if fn == "cast_string":
+            v = _eval(e.args[0], df, time_col)
+            return v.map(lambda x: None if pd.isna(x) else str(x))
+        if fn in ("substr", "substring"):
+            v = _eval(e.args[0], df, time_col)
+            start = int(e.args[1].value) - 1  # SQL 1-based
+            ln = int(e.args[2].value) if len(e.args) == 3 else None
+            end = None if ln is None else start + ln
+            return v.map(lambda x: None if pd.isna(x)
+                         else str(x)[start:end])
+        if fn == "regexp_extract":
+            v = _eval(e.args[0], df, time_col)
+            rx = re.compile(str(e.args[1].value))
+
+            def ex(x):
+                if pd.isna(x):
+                    return None
+                m = rx.search(str(x))
+                if m is None:
+                    return None
+                return m.group(1) if rx.groups else m.group(0)
+            return v.map(ex)
+        if fn in ("floor", "ceil", "sqrt", "log", "exp"):
+            v = _eval(e.args[0], df, time_col)
+            npf = {"floor": np.floor, "ceil": np.ceil, "sqrt": np.sqrt,
+                   "log": np.log, "exp": np.exp}[fn]
+            return pd.Series(npf(v.astype(float)), index=v.index)
+        if fn == "pow":
+            a = _eval(e.args[0], df, time_col)
+            b = _eval(e.args[1], df, time_col)
+            return a.astype(float) ** (b if not hasattr(b, "astype")
+                                       else b.astype(float))
+        if fn in ("min", "least", "max", "greatest"):
+            a = _eval(e.args[0], df, time_col)
+            b = _eval(e.args[1], df, time_col)
+            f = np.minimum if fn in ("min", "least") else np.maximum
+            return pd.Series(f(a, b), index=getattr(a, "index", df.index))
         raise FallbackError(f"unknown function {fn!r}")
     raise FallbackError(f"cannot evaluate {e!r}")
 

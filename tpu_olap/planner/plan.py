@@ -49,10 +49,17 @@ class RewriteError(Exception):
 
 _CMP = ("==", "!=", "<", "<=", ">", ">=")
 _TIME_FUNCS = {"year": ("YYYY", "int"), "month": ("MM", "int"),
-               "day": ("dd", "int"), "dayofmonth": ("dd", "int")}
+               "day": ("dd", "int"), "dayofmonth": ("dd", "int"),
+               "quarter": ("Q", "int")}
 _TRUNC_UNITS = {"second": "PT1S", "minute": "PT1M", "hour": "PT1H",
                 "day": "P1D", "week": "P1W", "month": "P1M",
                 "quarter": "P3M", "year": "P1Y"}
+# scalar functions the device expression evaluator implements
+# (kernels.exprs._call) — anything else in a virtual column or expression
+# filter must fall back BEFORE dispatch, not die inside the kernel
+_DEVICE_FUNCS = {"abs", "floor", "ceil", "sqrt", "log", "exp", "pow", "if",
+                 "min", "max", "least", "greatest", "cast_long",
+                 "cast_double"}
 
 
 @dataclass
@@ -138,7 +145,18 @@ class _Rewriter:
             filter_spec = F.and_of(*[self._to_filter(e) for e in conjuncts])
 
         group_exprs = [self._resolve(e) for e in stmt.group_by]
-        projections = [(self._resolve(e), a) for e, a in stmt.projections]
+        projections = []
+        for e, a in stmt.projections:
+            r = self._resolve(e)
+            if a is None and r != e and not (isinstance(e, Col)
+                                             and "." in e.name):
+                # star-join renames (r_name -> c_region) and time-column
+                # mapping (ts -> __time) must not leak into the output
+                # header: the column is named by what the user wrote
+                a = _render(e)
+            elif a is None and isinstance(e, Col) and "." in e.name:
+                a = e.name.split(".")[-1]
+            projections.append((r, a))
         if stmt.distinct:
             if self._has_agg(projections):
                 raise RewriteError("SELECT DISTINCT with aggregates")
@@ -160,7 +178,12 @@ class _Rewriter:
     def _collapse_joins(self, conjuncts):
         """JoinTransform (SURVEY.md §4.3): every joined table must be a
         declared star dimension whose FK edge appears as an equi-join
-        condition; dim columns then rename to fact columns."""
+        condition AND whose fact-side linking column is derivable from the
+        denormalized fact — directly (a fact column), through an earlier
+        collapsed dimension (snowflake dim⋈dim chains), or through the
+        declared FunctionalDependencies' closure (SURVEY.md §3.4: the
+        reference validates the join tree against StarSchema FK chains +
+        FDs). Dim columns then rename to fact columns."""
         stmt = self.stmt
         if not stmt.joins:
             return conjuncts
@@ -168,9 +191,19 @@ class _Rewriter:
         if star is None:
             raise RewriteError("join query but no star schema declared")
         conjuncts = list(conjuncts)
-        for j in stmt.joins:
-            if j.kind != "inner":
-                raise RewriteError(f"{j.kind} join not collapsible")
+        # columns derivable from the denormalized fact row, in bare-name
+        # space (grows as dimensions collapse — chain joins link through
+        # earlier dims' columns)
+        known = set(self.table.schema)
+        if self.entry.time_column:
+            known.add(self.entry.time_column)
+        known = star.fd_closure(known)
+
+        def collapse(j):
+            """Collapse one join into (renames, new conjuncts); returns an
+            error string when the join cannot collapse YET (it may become
+            collapsible after another dimension provides its link)."""
+            nonlocal conjuncts, known
             sd = star.dim(j.table)
             if sd is None:
                 raise RewriteError(
@@ -184,23 +217,53 @@ class _Rewriter:
                     found = c
                     break
             if found is None:
-                raise RewriteError(
-                    f"no FK join condition for star dimension {j.table!r}")
+                return f"no FK join condition for star dimension {j.table!r}"
+            if sd.fact_key not in known:
+                return (
+                    f"join to {j.table!r} is not subsumed by the star "
+                    f"schema: linking column {sd.fact_key!r} is not on "
+                    "the fact table, not provided by another collapsed "
+                    "dimension, and not implied by any declared "
+                    "functional dependency")
             if j.on is not None:
-                rest = [c for c in _split_and(j.on) if c is not found]
-                conjuncts.extend(rest)
+                conjuncts.extend(
+                    c for c in _split_and(j.on) if c is not found)
             else:
                 conjuncts.remove(found)
-            # rename dim columns -> denormalized fact columns
+            # rename dim columns -> denormalized fact columns; every dim
+            # column (mapped or not) joins the known set so snowflake
+            # chains can link through it
             dim_entry = self.catalog.maybe(j.table)
             dim_cols = (list(dim_entry.frame.columns)
                         if dim_entry is not None else [])
+            known.add(sd.dim_key)
             for c in dim_cols:
+                known.add(c)
                 fact_col = sd.fact_column(c)
                 if fact_col in self.table.schema or \
                         fact_col == self.entry.time_column:
                     self.rename[c] = fact_col
                     self.rename[f"{j.table}.{c}"] = fact_col
+            known = star.fd_closure(known)
+            return None
+
+        # fixed point over the join list: SQL join order need not follow
+        # the chain direction (the reference walks the whole tree too)
+        pending = list(stmt.joins)
+        for j in pending:
+            if j.kind != "inner":
+                raise RewriteError(f"{j.kind} join not collapsible")
+        while pending:
+            errors = []
+            still = []
+            for j in pending:
+                err = collapse(j)
+                if err is not None:
+                    errors.append(err)
+                    still.append(j)
+            if len(still) == len(pending):  # no progress
+                raise RewriteError(errors[0])
+            pending = still
         return conjuncts
 
     # ---------------------------------------------------- column resolution
@@ -242,21 +305,64 @@ class _Rewriter:
 
     def _extract_intervals(self, conjuncts):
         """IntervalConditionExtractor analog (SURVEY.md §3.2): conjuncts
-        over the time column become query intervals."""
-        iv = ETERNITY
+        over the time column become query intervals. A conjunct that is an
+        OR of pure time ranges becomes a multi-interval list (the SQL
+        spelling of Druid's interval arrays) — intervals across conjuncts
+        intersect pairwise, and overlapping results coalesce."""
+        sets = []  # each conjunct's interval alternatives (OR = union)
         rest = []
         for c in conjuncts:
             got = self._time_condition(c)
+            if got is not None:
+                sets.append([got])
+                continue
+            alts = self._or_intervals(c)
+            if alts is not None:
+                sets.append(alts)
+                continue
+            if _mentions_time_fn(c):
+                raise RewriteError(
+                    f"time condition not extractable: {c!r}")
+            rest.append(c)
+        acc = [ETERNITY]
+        for s in sets:
+            acc = [x for a in acc for b in s
+                   if (x := a.intersect(b)) is not None]
+            if not acc:
+                acc = [Interval(0, 0)]
+                break
+        acc.sort(key=lambda iv: iv.start)
+        merged = []
+        for iv in acc:
+            if merged and iv.start <= merged[-1].end:
+                if iv.end > merged[-1].end:
+                    merged[-1] = Interval(merged[-1].start, iv.end)
+            else:
+                merged.append(iv)
+        intervals = () if merged == [ETERNITY] else tuple(merged)
+        return intervals, rest
+
+    def _or_intervals(self, e):
+        """Intervals for a disjunction of pure time ranges (each branch
+        may be an AND of time conditions); None when any branch involves
+        non-time predicates."""
+        if isinstance(e, BinOp) and e.op == "||":
+            left = self._or_intervals(e.left)
+            right = self._or_intervals(e.right)
+            if left is None or right is None:
+                return None
+            return left + right
+        iv = None
+        for p in _split_and(e):
+            got = self._time_condition(p)
             if got is None:
-                if _mentions_time_fn(c):
-                    raise RewriteError(
-                        f"time condition not extractable: {c!r}")
-                rest.append(c)
+                return None
+            if iv is None:
+                iv = got
             else:
                 x = iv.intersect(got)
                 iv = x if x is not None else Interval(0, 0)
-        intervals = () if iv == ETERNITY else (iv,)
-        return intervals, rest
+        return [iv] if iv is not None else None
 
     def _time_condition(self, e) -> Interval | None:
         if not isinstance(e, BinOp) or e.op not in _CMP:
@@ -330,9 +436,16 @@ class _Rewriter:
             return F.LikeFilter(col, pat.value)
         if isinstance(e, BinOp) and e.op in _CMP:
             left, right, op = e.left, e.right, e.op
-            if isinstance(left, Lit) and isinstance(right, Col):
+            if isinstance(left, Lit) and (isinstance(right, Col) or
+                                          isinstance(right, FuncCall)):
                 left, right = right, left
                 op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+            if isinstance(right, Lit) and op in ("==", "!="):
+                ext = self._extraction_of(left)
+                if ext is not None:
+                    col, fn = ext
+                    f = F.SelectorFilter(col, right.value, fn)
+                    return F.NotFilter(f) if op == "!=" else f
             if isinstance(left, Col) and isinstance(right, Lit):
                 col = self._check_col(left.name)
                 v = right.value
@@ -361,11 +474,43 @@ class _Rewriter:
         return self._check_col(e.name)
 
     def _expression_filter(self, e) -> F.FilterSpec:
+        _check_device_expr(e)
         for c in e.columns():
             if self._col_type(c) is ColumnType.STRING:
                 raise RewriteError(
                     f"expression predicate over string column {c!r}")
         return F.ExpressionFilter(e)
+
+    def _extraction_of(self, e) -> tuple[str, object] | None:
+        """substr/substring/regexp_extract over a string column with
+        literal args -> (column, ExtractionFunctionSpec) — the SQL
+        spelling of the reference's extraction dimensions/filters
+        (SURVEY.md §3.3)."""
+        from tpu_olap.ir.dimensions import (RegexExtractionFn,
+                                            SubstringExtractionFn)
+        if not (isinstance(e, FuncCall) and e.args
+                and isinstance(e.args[0], Col)):
+            return None
+        if e.name in ("substr", "substring") and len(e.args) in (2, 3) \
+                and all(isinstance(a, Lit) for a in e.args[1:]):
+            col = self._check_col(e.args[0].name)
+            if self._col_type(col) is not ColumnType.STRING:
+                raise RewriteError(
+                    f"{e.name} over non-string column {col!r}")
+            start = int(e.args[1].value)
+            if start < 1:
+                raise RewriteError("substr start index is 1-based")
+            length = int(e.args[2].value) if len(e.args) == 3 else None
+            return col, SubstringExtractionFn(start - 1, length)
+        if e.name == "regexp_extract" and len(e.args) == 2 and \
+                isinstance(e.args[1], Lit) and isinstance(e.args[1].value,
+                                                          str):
+            col = self._check_col(e.args[0].name)
+            if self._col_type(col) is not ColumnType.STRING:
+                raise RewriteError(
+                    f"regexp_extract over non-string column {col!r}")
+            return col, RegexExtractionFn(e.args[1].value)
+        return None
 
     # ----------------------------------------------------------- aggregates
 
@@ -377,6 +522,7 @@ class _Rewriter:
 
     def _vcol_for(self, e: Expr) -> tuple[str, str]:
         """Expression -> (virtual column name, value type)."""
+        _check_device_expr(e)
         for c in e.columns():
             if self._col_type(c) is ColumnType.STRING:
                 raise RewriteError(f"aggregate over string column {c!r}")
@@ -384,7 +530,7 @@ class _Rewriter:
         for c in e.columns():
             if self.table.schema[c] is ColumnType.DOUBLE:
                 vt = "double"
-        if _has_division(e):
+        if _has_division(e) or _has_float_lit(e) or _has_cast_double(e):
             vt = "double"
         for v in self.vcols:
             if v.expression == e:
@@ -499,12 +645,19 @@ class _Rewriter:
             if isinstance(e, FuncCall) and e.name in _TIME_FUNCS and \
                     len(e.args) == 1 and e.args[0] == Col(TIME_COLUMN):
                 fmt, cast = _TIME_FUNCS[e.name]
-                name = alias or e.name
+                name = alias or _render(e)  # match fallback auto-naming
                 dims.append(ExtractionDimensionSpec(
                     TIME_COLUMN,
                     TimeFormatExtractionFn(fmt, self.config.time_zone),
                     name))
                 outputs[_key(e)] = OutputColumn(name, name, cast)
+                continue
+            ext = self._extraction_of(e)
+            if ext is not None:
+                col, fn = ext
+                name = alias or _render(e)
+                dims.append(ExtractionDimensionSpec(col, fn, name))
+                outputs[_key(e)] = OutputColumn(name, name)
                 continue
             if isinstance(e, FuncCall) and e.name == "date_trunc" and \
                     len(e.args) == 2 and isinstance(e.args[0], Lit) and \
@@ -517,7 +670,7 @@ class _Rewriter:
                 trunc_seen = True
                 granularity = PeriodGranularity(_TRUNC_UNITS[unit],
                                                 self.config.time_zone)
-                name = alias or "date_trunc"
+                name = alias or _render(e)  # match fallback auto-naming
                 outputs[_key(e)] = OutputColumn(name, "timestamp",
                                                 "datetime")
                 continue
@@ -640,13 +793,24 @@ class _Rewriter:
                 src = self._agg_by_key[key]
             elif isinstance(e, Col) and e.name in by_source:
                 src = by_source[e.name]
+            elif isinstance(item.expr, Col) and \
+                    item.expr.name.split(".")[-1] in by_source:
+                # the written name: star-join renames (r_name -> c_region)
+                # resolve the expr away from the output header it matches
+                src = by_source[item.expr.name.split(".")[-1]]
             elif _contains_agg(e):
                 src = self._agg_output(e)
             else:
                 raise RewriteError(
                     f"ORDER BY {_render(e)} is not an output column")
             dim_names = {d.name for d in dims}
-            order = ("lexicographic" if src in dim_names else "numeric")
+            long_dims = {d.name for d in dims
+                         if isinstance(d, DefaultDimensionSpec)
+                         and self.table.schema.get(d.dimension)
+                         is ColumnType.LONG}
+            order = ("lexicographic"
+                     if src in dim_names and src not in long_dims
+                     else "numeric")
             cols.append(OrderByColumnSpec(
                 src, "descending" if item.descending else "ascending",
                 order))
@@ -732,5 +896,50 @@ def _has_division(e) -> bool:
     if isinstance(e, FuncCall):
         return any(_has_division(a) for a in e.args)
     return False
+
+
+def _has_float_lit(e) -> bool:
+    if isinstance(e, Lit):
+        return isinstance(e.value, float)
+    if isinstance(e, BinOp):
+        return _has_float_lit(e.left) or _has_float_lit(e.right)
+    if isinstance(e, FuncCall):
+        return any(_has_float_lit(a) for a in e.args)
+    return False
+
+
+def _has_cast_double(e) -> bool:
+    if isinstance(e, FuncCall):
+        return e.name == "cast_double" or \
+            any(_has_cast_double(a) for a in e.args)
+    if isinstance(e, BinOp):
+        return _has_cast_double(e.left) or _has_cast_double(e.right)
+    return False
+
+
+def _check_device_expr(e) -> None:
+    """Reject expressions the device evaluator cannot run (unknown
+    functions, NULL literals from CASE-without-ELSE) so the planner falls
+    back cleanly instead of failing inside a jitted kernel."""
+    if isinstance(e, Lit):
+        if e.value is None:
+            raise RewriteError(
+                "NULL literal inside a device expression (add an ELSE "
+                "branch to CASE)")
+        return
+    if isinstance(e, Col):
+        return
+    if isinstance(e, BinOp):
+        _check_device_expr(e.left)
+        _check_device_expr(e.right)
+        return
+    if isinstance(e, FuncCall):
+        if e.name not in _DEVICE_FUNCS:
+            raise RewriteError(
+                f"function {e.name!r} not supported in device expressions")
+        for a in e.args:
+            _check_device_expr(a)
+        return
+    raise RewriteError(f"cannot compile expression {e!r}")
 
 

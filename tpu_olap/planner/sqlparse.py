@@ -37,6 +37,16 @@ _KEYWORDS = {
     "select", "from", "where", "group", "by", "having", "order", "limit",
     "offset", "as", "and", "or", "not", "between", "in", "like", "is",
     "null", "asc", "desc", "join", "inner", "left", "on", "distinct",
+    "case", "when", "then", "else", "end", "cast",
+}
+
+# CAST target type -> internal conversion function (kernels.exprs)
+_CAST_FNS = {
+    "double": "cast_double", "float": "cast_double", "real": "cast_double",
+    "long": "cast_long", "int": "cast_long", "integer": "cast_long",
+    "bigint": "cast_long", "smallint": "cast_long", "tinyint": "cast_long",
+    "varchar": "cast_string", "string": "cast_string", "char": "cast_string",
+    "text": "cast_string",
 }
 
 
@@ -328,6 +338,19 @@ class _Parser:
         if k == "kw" and v == "null":
             self.take()
             return Lit(None)
+        if k == "kw" and v == "case":
+            return self._case()
+        if k == "kw" and v == "cast":
+            self.take()
+            self.take("op", "(")
+            e = self.expr()
+            self.take_kw("as")
+            tname = self.take("name").lower()
+            self.take("op", ")")
+            fn = _CAST_FNS.get(tname)
+            if fn is None:
+                raise SqlError(f"unknown CAST type {tname!r}")
+            return FuncCall(fn, (e,))
         if k == "name":
             self.take()
             if self.peek() == ("op", "("):
@@ -358,6 +381,32 @@ class _Parser:
             self.take("op", ")")
             return e
         raise SqlError(f"unexpected token {v!r}")
+
+    def _case(self):
+        """CASE [operand] WHEN c THEN v ... [ELSE d] END -> nested if()."""
+        self.take_kw("case")
+        operand = None
+        if not self.at_kw("when"):
+            operand = self.expr()  # simple CASE: compare operand = value
+        branches = []
+        while self.at_kw("when"):
+            self.take()
+            cond = self.expr()
+            if operand is not None:
+                cond = BinOp("==", operand, cond)
+            self.take_kw("then")
+            branches.append((cond, self.expr()))
+        if not branches:
+            raise SqlError("CASE without WHEN")
+        default = Lit(None)
+        if self.at_kw("else"):
+            self.take()
+            default = self.expr()
+        self.take_kw("end")
+        e = default
+        for cond, val in reversed(branches):
+            e = FuncCall("if", (cond, val, e))
+        return e
 
 
 def parse_sql(sql: str) -> SelectStmt:

@@ -1,9 +1,24 @@
 """Parquet/Arrow/pandas -> TableSegments.
 
 The analog of the reference's L0→L1 data path: the raw fact table Druid
-would have indexed is ingested directly into HBM-ready columnar blocks
-(BASELINE.json:5 "streams Parquet→HBM"). Host-side work: type mapping,
-time-sort, global dictionary build, fixed-size blocking with padding.
+would have indexed is ingested into HBM-ready columnar blocks
+(BASELINE.json:5 "streams Parquet→HBM"). Two entry shapes:
+
+- In-memory (`ingest_arrow` / `ingest_pandas`): whole table at once,
+  globally time-sorted (best interval pruning).
+- Streaming (`ingest_parquet` / `ingest_parquet_stream`): row-group
+  batches from one or many parquet files under bounded host memory —
+  the SF100-shaped path (SURVEY.md §8.4 #4). Only one batch of decoded
+  Arrow data is transient at a time; strings are dictionary-encoded to
+  int32 temp codes immediately (the raw strings are dropped per batch)
+  and remapped to the final *sorted* dictionary in a finalize pass, so
+  lexicographic bound filters stay pure code-range compares.
+
+Numeric storage narrows to the smallest int dtype the observed value
+range allows (int8/int16/int32/int64; dictionary codes narrow by
+cardinality) — at SF100 this is the difference between fitting in host
+RAM + HBM or not. Kernels widen to accumulator dtypes on device
+(kernels.exprs.widen_int_env), so narrowing is invisible to results.
 """
 
 from __future__ import annotations
@@ -16,13 +31,350 @@ from tpu_olap.segments.segment import (ColumnType, Segment, SegmentMeta,
 
 DEFAULT_BLOCK_ROWS = 1 << 16
 
+_NARROW_INTS = (np.int8, np.int16, np.int32)
 
-def ingest_parquet(name: str, path: str, time_column: str | None = None,
-                   block_rows: int = DEFAULT_BLOCK_ROWS,
-                   columns=None) -> TableSegments:
-    import pyarrow.parquet as pq
-    table = pq.read_table(path, columns=list(columns) if columns else None)
-    return ingest_arrow(name, table, time_column, block_rows)
+
+def _int_dtype_for(lo: int, hi: int):
+    """Smallest signed int dtype holding [lo, hi]. The most negative
+    value of each dtype is excluded (kept free as a sentinel, matching
+    executor.dataset's convention)."""
+    for dt in _NARROW_INTS:
+        info = np.iinfo(dt)
+        if lo >= info.min + 1 and hi <= info.max:
+            return np.dtype(dt)
+    return np.dtype(np.int64)
+
+
+def _code_dtype_for(cardinality: int):
+    """Dtype for dictionary codes 0..cardinality (0 = null slot)."""
+    return _int_dtype_for(0, cardinality)
+
+
+class DictBuilder:
+    """Incremental string dictionary: values get insertion-order temp
+    codes (1-based; 0 = null) during streaming; finalize() sorts and
+    returns the remap so stored codes become sorted-order codes."""
+
+    def __init__(self):
+        self._map: dict[str, int] = {}
+
+    def encode(self, arr) -> np.ndarray:
+        """object array (None/NaN = null) -> int32 temp codes."""
+        import pandas as pd
+        a = np.asarray(arr, dtype=object)
+        null = np.asarray(pd.isna(a), dtype=bool)
+        codes = np.zeros(len(a), dtype=np.int32)
+        if null.all():
+            return codes
+        real = a[~null].astype(str)
+        uniq, inv = np.unique(real, return_inverse=True)
+        ids = np.empty(len(uniq), dtype=np.int32)
+        m = self._map
+        for i, v in enumerate(uniq):
+            code = m.get(v)
+            if code is None:
+                code = len(m) + 1
+                m[v] = code
+            ids[i] = code
+        codes[~null] = ids[inv]
+        return codes
+
+    def finalize(self) -> tuple[Dictionary, np.ndarray]:
+        """(sorted Dictionary, remap) with remap[temp_code] = final code."""
+        values = np.array(sorted(self._map), dtype=str)
+        remap = np.zeros(len(self._map) + 1, dtype=np.int32)
+        for final_idx, v in enumerate(values):
+            remap[self._map[v]] = final_idx + 1
+        return Dictionary(values), remap
+
+
+# --------------------------------------------------------------------------
+# Arrow column conversion (shared by in-memory and streaming paths)
+
+def _convert_time(tcol, n: int):
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    if tcol is None:
+        return np.zeros(n, dtype=np.int64)
+    if tcol.null_count:
+        raise ValueError(
+            "time column contains nulls; a non-null time value is "
+            "required per row (like Druid's __time)")
+    t = tcol.type
+    if pa.types.is_timestamp(t):
+        tms = pc.cast(tcol, pa.timestamp("ms"))
+        v = tms.combine_chunks().to_numpy(zero_copy_only=False)
+        return v.astype("datetime64[ms]").astype(np.int64)
+    if pa.types.is_date(t):
+        return (tcol.combine_chunks().to_numpy(zero_copy_only=False)
+                .astype("datetime64[ms]").astype(np.int64))
+    return tcol.combine_chunks().to_numpy(zero_copy_only=False) \
+        .astype(np.int64)
+
+
+def _convert_column(arr, n: int):
+    """Arrow array -> (ColumnType, values ndarray, null_mask | None).
+    STRING returns the raw object array (encoding is the caller's job)."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    arr = arr.combine_chunks() if hasattr(arr, "combine_chunks") else arr
+    t = arr.type
+    if pa.types.is_dictionary(t):
+        arr = pc.cast(arr, t.value_type)
+        t = t.value_type
+    null_mask = np.asarray(arr.is_null())
+    if pa.types.is_null(t):  # all-null column: treat as all-null STRING
+        return ColumnType.STRING, np.full(n, None, dtype=object), None
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return (ColumnType.STRING,
+                arr.to_pandas().to_numpy(dtype=object), None)
+    if pa.types.is_floating(t):
+        v = arr.to_numpy(zero_copy_only=False).astype(np.float64)
+        # genuine NaN values (valid Arrow values) fold into the null
+        # mask, matching SQL NULL semantics and keeping kernels NaN-free;
+        # +/-inf are preserved as real values
+        null_mask = null_mask | np.isnan(v)
+        return (ColumnType.DOUBLE, np.where(null_mask, 0.0, v),
+                null_mask if null_mask.any() else None)
+    if pa.types.is_integer(t) or pa.types.is_boolean(t):
+        v = arr.to_numpy(zero_copy_only=False)
+        if null_mask.any():
+            return (ColumnType.LONG,
+                    np.where(null_mask, 0, v).astype(np.int64), null_mask)
+        return ColumnType.LONG, v.astype(np.int64), None
+    if pa.types.is_timestamp(t) or pa.types.is_date(t):
+        v = (pc.cast(arr, pa.timestamp("ms"))
+             .to_numpy(zero_copy_only=False)
+             .astype("datetime64[ms]").astype(np.int64))
+        return ColumnType.LONG, v, null_mask if null_mask.any() else None
+    if pa.types.is_decimal(t):
+        v = np.array([float(x) if x is not None else 0.0
+                      for x in arr.to_pylist()], dtype=np.float64)
+        return ColumnType.DOUBLE, v, null_mask if null_mask.any() else None
+    raise TypeError(f"unsupported column type {t}")
+
+
+# --------------------------------------------------------------------------
+# Streaming ingestor
+
+class StreamIngestor:
+    """Accumulates converted batches into fixed-size segment blocks.
+
+    Memory profile: the final encoded segment store (narrow ints + codes)
+    plus one in-flight batch of decoded Arrow data; raw strings never
+    outlive their batch. Rows are time-sorted within each flush chunk
+    (not globally — per-segment time_min/max stay exact for pruning, like
+    Druid segments, which are interval-partitioned but not row-sorted)."""
+
+    def __init__(self, name: str, time_column: str | None = None,
+                 block_rows: int = DEFAULT_BLOCK_ROWS):
+        self.name = name
+        self.time_column = time_column
+        self.block_rows = block_rows
+        self.schema: dict | None = None
+        self._dicts: dict[str, DictBuilder] = {}
+        self._segments: list[Segment] = []
+        self._pending: list[dict] = []      # per-batch {col: values}
+        self._pending_nulls: list[dict] = []
+        self._pending_rows = 0
+        self._finalized = False
+
+    # ---- batch intake ----------------------------------------------------
+
+    def add_arrow(self, table) -> None:
+        """Add a pyarrow Table/RecordBatch worth of rows."""
+        import pyarrow as pa
+        if isinstance(table, pa.RecordBatch):
+            table = pa.Table.from_batches([table])
+        n = table.num_rows
+        if n == 0 and self.schema is not None:
+            return  # zero-row batches still establish the schema once
+        tc = self.time_column
+        if tc is None and self.schema is None \
+                and TIME_COLUMN in table.schema.names:
+            # a Druid-exported table carries its own __time column
+            self.time_column = tc = TIME_COLUMN
+
+        cols: dict = {}
+        nulls: dict = {}
+        cols[TIME_COLUMN] = _convert_time(
+            table.column(tc) if tc is not None else None, n)
+        schema = {TIME_COLUMN: ColumnType.LONG}
+        for fld in table.schema:
+            c = fld.name
+            if c == tc or c == TIME_COLUMN:
+                continue
+            try:
+                typ, v, nm = _convert_column(table.column(c), n)
+            except (TypeError, ValueError) as e:
+                raise type(e)(f"column {c!r}: {e}") from None
+            schema[c] = typ
+            if typ is ColumnType.STRING:
+                v = self._dicts.setdefault(c, DictBuilder()).encode(v)
+            cols[c] = v
+            if nm is not None:
+                nulls[c] = nm
+
+        if self.schema is None:
+            self.schema = schema
+        elif schema != self.schema:
+            missing = set(self.schema) ^ set(schema)
+            raise ValueError(
+                f"batch schema mismatch for table {self.name!r}"
+                + (f" (columns differ: {sorted(missing)})" if missing
+                   else " (column types differ)"))
+        self._pending.append(cols)
+        self._pending_nulls.append(nulls)
+        self._pending_rows += n
+        if self._pending_rows >= self.block_rows:
+            # emit every full block in one pass (one concatenate, not one
+            # per block — an in-memory whole-table add stays O(N))
+            self._flush(self._pending_rows
+                        - self._pending_rows % self.block_rows)
+
+    # ---- block emission --------------------------------------------------
+
+    def _flush(self, rows: int) -> None:
+        """Emit full blocks from the first `rows` pending rows (the chunk
+        is time-sorted first); the remainder is carried forward."""
+        cat = {c: np.concatenate([p[c] for p in self._pending])
+               for c in self._pending[0]}
+        nset = set().union(*(n.keys() for n in self._pending_nulls)) \
+            if self._pending_nulls else set()
+        cat_nulls = {}
+        for c in nset:
+            cat_nulls[c] = np.concatenate([
+                n.get(c, np.zeros(len(p[TIME_COLUMN]), bool))
+                for p, n in zip(self._pending, self._pending_nulls)])
+
+        order = np.argsort(cat[TIME_COLUMN][:rows], kind="stable")
+        n_blocks = rows // self.block_rows if rows >= self.block_rows else 1
+        emit = n_blocks * self.block_rows if rows >= self.block_rows else rows
+        for b in range(n_blocks):
+            lo = b * self.block_rows
+            hi = min((b + 1) * self.block_rows, emit)
+            idx = order[lo:hi]
+            self._emit_block(
+                {c: v[idx] for c, v in cat.items()},
+                {c: m[idx] for c, m in cat_nulls.items()}, hi - lo)
+
+        if emit < self._pending_rows:
+            rest = np.arange(emit, self._pending_rows)
+            self._pending = [{c: v[rest] for c, v in cat.items()}]
+            self._pending_nulls = [
+                {c: m[rest] for c, m in cat_nulls.items()}]
+        else:
+            self._pending = []
+            self._pending_nulls = []
+        self._pending_rows -= emit
+
+    def _emit_block(self, vals: dict, nulls: dict, nv: int) -> None:
+        cols, masks = {}, {}
+        for c, v in vals.items():
+            # per-block narrow storage (promoted to the global dtype at
+            # finalize; global range ⊇ block range so promotion is safe)
+            if v.dtype.kind == "i" and c != TIME_COLUMN and \
+                    self.schema[c] is ColumnType.LONG and nv:
+                v = v.astype(_int_dtype_for(int(v[:nv].min()),
+                                            int(v[:nv].max())))
+            block = np.zeros(self.block_rows, dtype=v.dtype)
+            block[:nv] = v
+            cols[c] = block
+        for c, m in nulls.items():
+            block = np.zeros(self.block_rows, dtype=bool)
+            block[:nv] = m
+            masks[c] = block
+        t = cols[TIME_COLUMN][:nv]
+        meta = SegmentMeta(
+            segment_id=len(self._segments), n_valid=nv,
+            time_min=int(t.min()) if nv else 0,
+            time_max=int(t.max()) if nv else 0,
+        )
+        for c, typ in self.schema.items():
+            if typ is not ColumnType.STRING and nv:
+                cv = cols[c][:nv]
+                nm = masks.get(c)
+                if nm is not None and nm[:nv].all():
+                    continue
+                if nm is not None and nm[:nv].any():
+                    cv = cv[~nm[:nv]]
+                meta.column_min[c] = _scalar(cv.min())
+                meta.column_max[c] = _scalar(cv.max())
+        self._segments.append(Segment(meta, cols, masks))
+
+    # ---- finalize --------------------------------------------------------
+
+    def finalize(self) -> TableSegments:
+        assert not self._finalized, "finalize() called twice"
+        self._finalized = True
+        if self._pending_rows or not self._segments:
+            if not self._pending_rows and not self._segments:
+                # empty table: one empty segment keeps shapes non-degenerate
+                if self.schema is None:
+                    self.schema = {TIME_COLUMN: ColumnType.LONG}
+                self._emit_block(
+                    {c: np.zeros(0, np.int64 if t is not ColumnType.DOUBLE
+                                 else np.float64)
+                     for c, t in self.schema.items()}, {}, 0)
+            elif self._pending_rows:
+                self._flush(self._pending_rows)
+
+        # sorted-dictionary remap for stored temp codes
+        dictionaries: dict = {}
+        remaps: dict = {}
+        for c, b in self._dicts.items():
+            dictionaries[c], remaps[c] = b.finalize()
+        for c, typ in self.schema.items():  # zero-batch STRING edge
+            if typ is ColumnType.STRING and c not in dictionaries:
+                dictionaries[c] = Dictionary(np.array([], dtype=str))
+
+        # global dtype per column: codes narrow by cardinality, LONGs by
+        # the manifest's min/max envelope
+        target: dict = {}
+        for c, typ in self.schema.items():
+            if typ is ColumnType.STRING:
+                d = dictionaries.get(c)
+                target[c] = _code_dtype_for(d.cardinality if d else 0)
+            elif typ is ColumnType.LONG and c != TIME_COLUMN:
+                lo = hi = None
+                for s in self._segments:
+                    mlo = s.meta.column_min.get(c)
+                    if mlo is None:
+                        continue
+                    mhi = s.meta.column_max.get(c)
+                    lo = mlo if lo is None else min(lo, mlo)
+                    hi = mhi if hi is None else max(hi, mhi)
+                target[c] = _int_dtype_for(lo, hi) if lo is not None \
+                    else np.dtype(np.int8)
+        for s in self._segments:
+            for c, dt in target.items():
+                v = s.columns[c]
+                r = remaps.get(c)
+                if r is not None:
+                    v = r[v]
+                s.columns[c] = v.astype(dt, copy=False)
+
+        return TableSegments(self.name, self.schema, dictionaries,
+                             self._segments, self.block_rows)
+
+
+# --------------------------------------------------------------------------
+# Entry points
+
+def ingest_arrow(name: str, table, time_column: str | None = None,
+                 block_rows: int = DEFAULT_BLOCK_ROWS) -> TableSegments:
+    """In-memory ingest: globally time-sorted segments."""
+    ing = StreamIngestor(name, time_column, block_rows)
+    if time_column is None and TIME_COLUMN in table.schema.names:
+        time_column = TIME_COLUMN
+    if time_column is not None and table.num_rows:
+        tvals = _convert_time(table.column(time_column), table.num_rows)
+        order = np.argsort(tvals, kind="stable")
+        if not np.array_equal(order, np.arange(table.num_rows)):
+            table = table.take(order)
+    ing.add_arrow(table)
+    return ing.finalize()
 
 
 def ingest_pandas(name: str, df, time_column: str | None = None,
@@ -32,139 +384,41 @@ def ingest_pandas(name: str, df, time_column: str | None = None,
                         time_column, block_rows)
 
 
-def ingest_arrow(name: str, table, time_column: str | None = None,
-                 block_rows: int = DEFAULT_BLOCK_ROWS) -> TableSegments:
-    import pyarrow as pa
-    import pyarrow.compute as pc
+def ingest_parquet(name: str, path, time_column: str | None = None,
+                   block_rows: int = DEFAULT_BLOCK_ROWS,
+                   columns=None, column_map: dict | None = None,
+                   batch_rows: int | None = None) -> TableSegments:
+    """Streaming parquet ingest; `path` may be one path or a list."""
+    return ingest_parquet_stream(name, path, time_column, block_rows,
+                                 columns, column_map, batch_rows)
 
-    schema: dict = {}
-    raw: dict = {}      # col -> numpy array (pre-encoding)
-    nulls: dict = {}    # col -> bool mask
 
-    # ---- time column -> __time (epoch millis int64) ----------------------
-    n = table.num_rows
-    if time_column is None and TIME_COLUMN in table.schema.names:
-        # a Druid-exported table carries its own __time column; use it
-        time_column = TIME_COLUMN
-    if time_column is not None:
-        tcol = table.column(time_column)
-        if tcol.null_count:
-            raise ValueError(
-                f"time column {time_column!r} contains nulls; a non-null "
-                "time value is required per row (like Druid's __time)")
-        if pa.types.is_timestamp(tcol.type):
-            tms = pc.cast(tcol, pa.timestamp("ms"))
-            tvals = tms.combine_chunks().to_numpy(zero_copy_only=False)
-            tvals = tvals.astype("datetime64[ms]").astype(np.int64)
-        elif pa.types.is_date(tcol.type):
-            tvals = (tcol.combine_chunks().to_numpy(zero_copy_only=False)
-                     .astype("datetime64[ms]").astype(np.int64))
-        else:  # already numeric epoch millis
-            tvals = tcol.combine_chunks().to_numpy(zero_copy_only=False) \
-                .astype(np.int64)
-    else:
-        tvals = np.zeros(n, dtype=np.int64)
-    raw[TIME_COLUMN] = tvals
-    schema[TIME_COLUMN] = ColumnType.LONG
+def ingest_parquet_stream(name: str, paths, time_column: str | None = None,
+                          block_rows: int = DEFAULT_BLOCK_ROWS,
+                          columns=None, column_map: dict | None = None,
+                          batch_rows: int | None = None) -> TableSegments:
+    """Row-group streaming ingest over one or many parquet files under
+    bounded host memory (SURVEY.md §8.4 #4 / BASELINE.json:5 "streams
+    Parquet→HBM"). `columns` / `column_map` use POST-rename names, like
+    Engine.register_table."""
+    import pyarrow.parquet as pq
 
-    # ---- other columns ---------------------------------------------------
-    for fld in table.schema:
-        col = fld.name
-        if col == time_column or col == TIME_COLUMN:
-            continue
-        arr = table.column(col).combine_chunks()
-        t = fld.type
-        if pa.types.is_dictionary(t):
-            arr = pc.cast(arr, t.value_type)
-            t = t.value_type
-        null_mask = np.asarray(arr.is_null())
-        if pa.types.is_null(t):  # all-null column: treat as all-null STRING
-            schema[col] = ColumnType.STRING
-            raw[col] = np.full(n, None, dtype=object)
-        elif pa.types.is_string(t) or pa.types.is_large_string(t):
-            schema[col] = ColumnType.STRING
-            raw[col] = arr.to_pandas().to_numpy(dtype=object)
-        elif pa.types.is_floating(t):
-            schema[col] = ColumnType.DOUBLE
-            v = arr.to_numpy(zero_copy_only=False).astype(np.float64)
-            # genuine NaN values (valid Arrow values) fold into the null
-            # mask, matching SQL NULL semantics and keeping kernels NaN-free;
-            # +/-inf are preserved as real values
-            null_mask = null_mask | np.isnan(v)
-            raw[col] = np.where(null_mask, 0.0, v)
-            if null_mask.any():
-                nulls[col] = null_mask
-        elif pa.types.is_integer(t) or pa.types.is_boolean(t):
-            schema[col] = ColumnType.LONG
-            v = arr.to_numpy(zero_copy_only=False)
-            if null_mask.any():
-                v = np.where(null_mask, 0, v)
-                nulls[col] = null_mask
-            raw[col] = v.astype(np.int64)
-        elif pa.types.is_timestamp(t) or pa.types.is_date(t):
-            schema[col] = ColumnType.LONG
-            raw[col] = (pc.cast(arr, pa.timestamp("ms"))
-                        .to_numpy(zero_copy_only=False)
-                        .astype("datetime64[ms]").astype(np.int64))
-            if null_mask.any():
-                nulls[col] = null_mask
-        elif pa.types.is_decimal(t):
-            schema[col] = ColumnType.DOUBLE
-            raw[col] = np.array([float(x) if x is not None else 0.0
-                                 for x in arr.to_pylist()], dtype=np.float64)
-            if null_mask.any():
-                nulls[col] = null_mask
-        else:
-            raise TypeError(f"unsupported column type {t} for {col!r}")
+    if isinstance(paths, str):
+        paths = [paths]
+    column_map = dict(column_map) if column_map else None
+    inverse = {v: k for k, v in (column_map or {}).items()}
+    read_cols = [inverse.get(c, c) for c in columns] if columns else None
 
-    # ---- sort by time (Druid segments are time-ordered) ------------------
-    order = np.argsort(raw[TIME_COLUMN], kind="stable")
-    if not np.array_equal(order, np.arange(n)):
-        raw = {c: v[order] for c, v in raw.items()}
-        nulls = {c: v[order] for c, v in nulls.items()}
-
-    # ---- global dictionaries + encoding ----------------------------------
-    dictionaries: dict = {}
-    encoded: dict = {}
-    for col, typ in schema.items():
-        if typ is ColumnType.STRING:
-            d, codes = Dictionary.build(raw[col])
-            dictionaries[col] = d
-            encoded[col] = codes
-        else:
-            encoded[col] = raw[col]
-
-    # ---- fixed-size blocking with padding --------------------------------
-    segments = []
-    n_blocks = max(1, -(-n // block_rows))
-    for b in range(n_blocks):
-        lo, hi = b * block_rows, min((b + 1) * block_rows, n)
-        nv = hi - lo
-        cols, masks = {}, {}
-        for col, v in encoded.items():
-            block = np.zeros(block_rows, dtype=v.dtype)
-            block[:nv] = v[lo:hi]
-            cols[col] = block
-        for col, m in nulls.items():
-            block = np.zeros(block_rows, dtype=bool)
-            block[:nv] = m[lo:hi]
-            masks[col] = block
-        t = cols[TIME_COLUMN][:nv]
-        meta = SegmentMeta(
-            segment_id=b, n_valid=nv,
-            time_min=int(t.min()) if nv else 0,
-            time_max=int(t.max()) if nv else 0,
-        )
-        for col, typ in schema.items():
-            if typ is not ColumnType.STRING and nv:
-                cv = cols[col][:nv]
-                nm = masks.get(col)
-                if nm is not None and nm[:nv].all():
-                    continue
-                if nm is not None and nm[:nv].any():
-                    cv = cv[~nm[:nv]]
-                meta.column_min[col] = _scalar(cv.min())
-                meta.column_max[col] = _scalar(cv.max())
-        segments.append(Segment(meta, cols, masks))
-
-    return TableSegments(name, schema, dictionaries, segments, block_rows)
+    ing = StreamIngestor(name, time_column, block_rows)
+    bs = batch_rows or block_rows
+    for path in paths:
+        pf = pq.ParquetFile(path)
+        try:
+            for batch in pf.iter_batches(batch_size=bs, columns=read_cols):
+                if column_map:
+                    batch = batch.rename_columns(
+                        [column_map.get(c, c) for c in batch.schema.names])
+                ing.add_arrow(batch)
+        finally:
+            pf.close()
+    return ing.finalize()
