@@ -1,0 +1,149 @@
+"""Chunked (streamed) fallback at scale: parity vs the whole-frame
+fallback interpreter on the same multi-file parquet dataset, forced by a
+tiny fallback_chunk_rows threshold (VERDICT round-2 task #7 — the
+"never an error" guarantee must not become an OOM at SF scale)."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpu_olap import Engine
+from tpu_olap.executor import EngineConfig
+from tpu_olap.planner.fallback import FallbackError, execute_fallback
+
+
+def _write_dataset(tmp_path, n=9000, files=3, seed=11):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    rng = np.random.default_rng(seed)
+    paths = []
+    per = n // files
+    for f in range(files):
+        df = pd.DataFrame({
+            "ts": pd.to_datetime("2021-01-01")
+            + pd.to_timedelta(rng.integers(0, 86400 * 200, per), unit="s"),
+            "cat": rng.choice(["a", "b", "c", None], per,
+                              p=[0.4, 0.3, 0.2, 0.1]),
+            "city": rng.choice([f"c{i}" for i in range(7)], per),
+            "qty": rng.integers(-20, 100, per).astype(np.int64),
+            "price": rng.integers(1, 1000, per).astype(np.int64),
+        })
+        df.loc[rng.random(per) < 0.06, "qty"] = np.nan
+        df["qty"] = df["qty"].astype("Int64")
+        p = os.path.join(tmp_path, f"part-{f}.parquet")
+        pq.write_table(pa.Table.from_pandas(df, preserve_index=False), p,
+                       row_group_size=512)
+        paths.append(p)
+    return paths
+
+
+def _engines(tmp_path):
+    paths = _write_dataset(str(tmp_path))
+    whole = Engine(EngineConfig(fallback_chunk_rows=10**9))
+    chunked = Engine(EngineConfig(fallback_chunk_rows=100,
+                                  fallback_chunk_batch_rows=1024))
+    for e in (whole, chunked):
+        e.register_table("t", paths, time_column="ts")
+        # dimension join target for the star-shaped cases
+        e.register_table("d", pd.DataFrame(
+            {"d_city": [f"c{i}" for i in range(7)],
+             "d_zone": ["west" if i < 4 else "east" for i in range(7)]}),
+            accelerate=False)
+    return whole, chunked
+
+
+QUERIES = [
+    # global aggregates incl. arithmetic over aggs
+    "SELECT sum(qty) AS s, count(*) AS n, avg(price) AS a, "
+    "sum(price * qty) AS pq FROM t",
+    # group-by with nulls in keys + HAVING over a nullable aggregate
+    "SELECT cat, sum(qty) AS s, count(qty) AS nq FROM t GROUP BY cat "
+    "HAVING sum(qty) > 0",
+    # multi-dim + order + limit
+    "SELECT cat, city, sum(price) AS s FROM t GROUP BY cat, city "
+    "ORDER BY s DESC, cat, city LIMIT 7",
+    # count distinct per group
+    "SELECT cat, count(DISTINCT city) AS dc FROM t GROUP BY cat ORDER BY cat",
+    # min/max incl. all-null-group behavior
+    "SELECT cat, min(qty) AS lo, max(qty) AS hi FROM t GROUP BY cat "
+    "ORDER BY cat",
+    # join to a dimension table per chunk
+    "SELECT d_zone, sum(price) AS s FROM t JOIN d ON city = d_city "
+    "GROUP BY d_zone ORDER BY d_zone",
+    # DISTINCT projection (grouped spelling)
+    "SELECT DISTINCT cat, city FROM t ORDER BY cat, city",
+    # non-aggregate scan with filter + limit
+    "SELECT city, price FROM t WHERE price > 900 ORDER BY price DESC, city "
+    "LIMIT 11",
+    # aggregate expression ORDER BY not in the projection list
+    "SELECT city, count(*) AS n FROM t GROUP BY city "
+    "ORDER BY sum(price) DESC LIMIT 4",
+]
+
+
+@pytest.mark.parametrize("sql", QUERIES)
+def test_chunked_matches_whole(tmp_path, sql):
+    whole, chunked = _engines(tmp_path)
+    a = execute_fallback(whole.planner.plan(sql).stmt, whole.catalog,
+                         whole.config)
+    b = execute_fallback(chunked.planner.plan(sql).stmt, chunked.catalog,
+                         chunked.config)
+    if "LIMIT" in sql and "ORDER BY" not in sql:
+        raise AssertionError("unreachable: all LIMIT cases are ordered")
+    pd.testing.assert_frame_equal(
+        a.reset_index(drop=True), b.reset_index(drop=True),
+        check_dtype=False)
+
+
+EDGE_QUERIES = [
+    # global aggregate whose filter matches zero rows (empty-partials
+    # branch must resolve real columns: count->0, sum->0)
+    "SELECT sum(qty) AS s, count(*) AS n FROM t WHERE price > 99999",
+    # division by a NULL aggregate (all-NULL min over a filtered group)
+    "SELECT cat, sum(price) / max(qty) AS r FROM t GROUP BY cat "
+    "ORDER BY cat",
+]
+
+
+@pytest.mark.parametrize("sql", EDGE_QUERIES)
+def test_chunked_edge_parity(tmp_path, sql):
+    whole, chunked = _engines(tmp_path)
+    a = execute_fallback(whole.planner.plan(sql).stmt, whole.catalog,
+                         whole.config)
+    b = execute_fallback(chunked.planner.plan(sql).stmt, chunked.catalog,
+                         chunked.config)
+    pd.testing.assert_frame_equal(
+        a.reset_index(drop=True), b.reset_index(drop=True),
+        check_dtype=False)
+
+
+def test_distinct_pair_cap_refuses(tmp_path):
+    """High-cardinality COUNT(DISTINCT) must refuse with a clear error,
+    not OOM: the pair frames count toward the compaction trigger and the
+    cap fires inside compact()."""
+    _, chunked = _engines(tmp_path)
+    chunked.config.fallback_scan_row_cap = 50
+    stmt = chunked.planner.plan(
+        "SELECT count(DISTINCT price) AS d FROM t").stmt
+    with pytest.raises(FallbackError, match="COUNT\\(DISTINCT\\)"):
+        execute_fallback(stmt, chunked.catalog, chunked.config)
+
+
+def test_scan_row_cap_refuses(tmp_path):
+    _, chunked = _engines(tmp_path)
+    chunked.config.fallback_scan_row_cap = 100
+    stmt = chunked.planner.plan("SELECT city, price FROM t").stmt
+    with pytest.raises(FallbackError, match="fallback_scan_row_cap"):
+        execute_fallback(stmt, chunked.catalog, chunked.config)
+
+
+def test_unordered_limit_scan_bounded(tmp_path):
+    """LIMIT without ORDER BY early-stops: only enough chunks stream."""
+    _, chunked = _engines(tmp_path)
+    chunked.config.fallback_scan_row_cap = 10**9
+    stmt = chunked.planner.plan(
+        "SELECT city FROM t LIMIT 5").stmt
+    out = execute_fallback(stmt, chunked.catalog, chunked.config)
+    assert len(out) == 5

@@ -143,6 +143,30 @@ def _gen_query(rng):
     return sql
 
 
+def test_having_null_aggregate_parity():
+    """HAVING over a NULL aggregate (sum of an all-NA group). Device path
+    surfaces the NULL as NaN, where every comparison is False and NOT
+    flips it to True; the fallback must collapse pd.NA identically
+    (VERDICT round-2 weak #1, fuzz seed 102)."""
+    frame = pd.DataFrame({
+        "ts": pd.to_datetime("2019-03-01") + pd.to_timedelta(
+            np.arange(6), unit="h"),
+        "cat": ["a", "a", "b", "b", "c", "c"],
+        "qty": pd.array([1, 2, None, None, -3, None], dtype="Int64"),
+    })
+    eng = Engine()
+    eng.register_table("t", frame, time_column="ts")
+    for having in ("sum(qty) > 0", "sum(qty) < 0", "sum(qty) = 0",
+                   "NOT (sum(qty) > 0)",
+                   "sum(qty) > 0 OR count(*) > 99",
+                   "sum(qty) > 0 AND count(*) > 0"):
+        sql = (f"SELECT cat, sum(qty) AS s FROM t GROUP BY cat "
+               f"HAVING {having}")
+        device, fb, _ = run_both(eng, sql)
+        assert_frame_parity(device, fb, ordered=False,
+                            label=f"having={having!r}")
+
+
 @pytest.mark.parametrize("seed", range(N_CASES))
 def test_fuzz_parity(seed):
     rng = np.random.default_rng(1000 + seed)

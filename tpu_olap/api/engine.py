@@ -72,6 +72,7 @@ class Engine:
             return tbl
 
         segments = None
+        pq_fields = {}
         if isinstance(data, str) or (
                 isinstance(data, (list, tuple))
                 and all(isinstance(p, str) for p in data)):
@@ -94,6 +95,12 @@ class Engine:
                     name, paths, time_column, block_rows,
                     columns=columns, column_map=column_map)
             frame_source = load_frame
+            pq_fields = dict(
+                parquet_paths=tuple(paths),
+                parquet_read_cols=tuple(read_cols) if read_cols else None,
+                parquet_column_map=column_map,
+                parquet_rows=sum(pq.ParquetFile(p).metadata.num_rows
+                                 for p in paths))
         elif isinstance(data, pd.DataFrame):
             frame = data.copy()
             if column_map:
@@ -120,7 +127,7 @@ class Engine:
         entry = TableEntry(name=name, segments=segments,
                            frame_source=frame_source,
                            time_column=time_column, star=star,
-                           options=dict(options))
+                           options=dict(options), **pq_fields)
         self.catalog.register(entry)
         return entry
 

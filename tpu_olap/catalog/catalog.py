@@ -28,9 +28,35 @@ class TableEntry:
     time_column: str | None = None
     star: StarSchema | None = None
     options: dict = field(default_factory=dict)
+    # parquet provenance (multi-file datasets): lets the fallback stream
+    # row-group chunks instead of materializing one giant frame
+    # (SURVEY.md §2 property 2 at SF scale — "never an error", not an OOM)
+    parquet_paths: tuple = ()
+    parquet_read_cols: tuple | None = None   # pre-rename names, None = all
+    parquet_column_map: dict | None = None
+    parquet_rows: int | None = None          # footer-metadata row estimate
     _frame: object = None
     _frame_lock: object = field(default_factory=threading.Lock,
                                 repr=False, compare=False)
+
+    def iter_chunks(self, batch_rows: int = 1 << 20):
+        """Stream the parquet source as renamed pandas frames of at most
+        batch_rows rows (parquet-registered tables only)."""
+        import pyarrow.parquet as pq
+        cmap = self.parquet_column_map
+        cols = list(self.parquet_read_cols) if self.parquet_read_cols \
+            else None
+        for path in self.parquet_paths:
+            pf = pq.ParquetFile(path)
+            try:
+                for batch in pf.iter_batches(batch_size=batch_rows,
+                                             columns=cols):
+                    df = batch.to_pandas()
+                    if cmap:
+                        df = df.rename(columns=cmap)
+                    yield df
+            finally:
+                pf.close()
 
     @property
     def frame(self):

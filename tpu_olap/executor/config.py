@@ -58,6 +58,17 @@ class EngineConfig:
     # same answer).
     result_group_cap: int = 1 << 16
 
+    # fallback-at-scale bounds (SURVEY.md §2 property 2 without the OOM):
+    # parquet-backed tables whose footer row count exceeds
+    # fallback_chunk_rows execute the fallback over streamed row-group
+    # chunks (partial aggregation; bounded resident rows) instead of
+    # materializing one frame; a chunked NON-aggregate result larger than
+    # fallback_scan_row_cap refuses with a clear error instead of eating
+    # host RAM.
+    fallback_chunk_rows: int = 4_000_000
+    fallback_chunk_batch_rows: int = 1 << 20
+    fallback_scan_row_cap: int = 20_000_000
+
     # execution platform: "device" = default jax backend, "cpu" = numpy path
     platform: str = "device"
 
@@ -80,9 +91,19 @@ class EngineConfig:
     # session timezone for granularity math (reference: tz.id conf key)
     time_zone: str = "UTC"
 
-    # cost model knobs (planner.cost)
+    # cost model knobs (planner.cost). The four constants default to the
+    # fitted values in planner/cost_calibration.json for the running
+    # backend (tools/calibrate_cost.py writes them) and fall back to the
+    # coarse built-ins; set explicitly to pin.
     cost_model_enabled: bool = True
     shard_merge_factor: float = 1.0
+    cost_scan_ns_per_row_col: float | None = None
+    cost_merge_ns_per_byte: float | None = None
+    cost_collective_lat_us: float | None = None
+    cost_gspmd_overhead: float | None = None
+    # calibration/debug override: pin the dispatch strategy
+    # ("historicals" | "broker"); None = cost-model decision
+    force_strategy: str | None = None
 
     # failure detection / elastic recovery (SURVEY.md §6): device dispatch
     # retries after purging device caches; with a mesh, repeated failure
@@ -112,10 +133,13 @@ class EngineConfig:
     # it on the TPU backend for eligible plans, "force" uses it everywhere
     # eligible (interpret mode off-TPU — for tests), "never" disables.
     use_pallas: str = "auto"
-    # max dense group count the one-hot [K, rows] tile may span — beyond
-    # this the VPU compare cost beats scatter anyway (K·N comparisons)
-    pallas_group_cap: int = 2048
+    # max dense group count the Pallas kernel serves — beyond this the
+    # VPU compare cost (K·N comparisons across K-blocks) beats scatter
+    pallas_group_cap: int = 8192
     pallas_rows_per_block: int = 1024
+    # K-block tile height: group spaces wider than this tile over a second
+    # grid axis ([KB, rb] one-hot per step instead of one [K, rb] tile)
+    pallas_k_per_block: int = 1024
 
     extra: dict = field(default_factory=dict)
 

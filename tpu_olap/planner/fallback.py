@@ -30,6 +30,11 @@ class FallbackError(Exception):
 
 def execute_fallback(stmt: SelectStmt, catalog, config) -> pd.DataFrame:
     entry = catalog.get(stmt.table)
+    if entry.parquet_paths and entry._frame is None and \
+            (entry.parquet_rows or 0) > config.fallback_chunk_rows:
+        # SF-scale parquet table: stream row-group chunks instead of
+        # materializing one frame (SURVEY.md §2 property 2 at scale)
+        return _execute_chunked(stmt, entry, catalog, config)
     df = entry.frame.copy()
     time_col = entry.time_column
     if time_col is not None and time_col in df.columns:
@@ -37,41 +42,7 @@ def execute_fallback(stmt: SelectStmt, catalog, config) -> pd.DataFrame:
         # (segments are time-sorted, so unordered LIMIT picks the same rows)
         df = df.sort_values(time_col, kind="stable")
 
-    # joins (inner equi-joins; conditions from ON or WHERE). Fixed point
-    # over the join list: a snowflake chain's parent may be listed after
-    # its child, and the link column only appears once the parent merges.
-    where_conjs = _split_and(stmt.where)
-    pending = list(stmt.joins)
-    while pending:
-        still = []
-        for j in pending:
-            other = catalog.get(j.table).frame
-            conds = _split_and(j.on) if j.on is not None else where_conjs
-            pair = None
-            for c in conds:
-                p = _equi_pair(c, df.columns, other.columns)
-                if p:
-                    pair = (c, p)
-                    break
-            if pair is None:
-                still.append(j)
-                continue
-            cond, (lcol, rcol) = pair
-            if j.on is None:
-                where_conjs.remove(cond)
-            how = "left" if j.kind == "left" else "inner"
-            df = df.merge(other, left_on=lcol, right_on=rcol, how=how,
-                          suffixes=("", f"__{j.table}"))
-            if j.on is not None:
-                for extra in [c for c in _split_and(j.on) if c is not cond]:
-                    df = df[_eval_bool(extra, df, time_col)]
-        if len(still) == len(pending):
-            raise FallbackError(
-                f"no join condition for {still[0].table!r}")
-        pending = still
-
-    for c in where_conjs:
-        df = df[_eval_bool(c, df, time_col)]
+    df = _join_and_filter(stmt, df, catalog, time_col)
 
     out_names = []
     exprs = []
@@ -116,6 +87,46 @@ def execute_fallback(stmt: SelectStmt, catalog, config) -> pd.DataFrame:
 # ---------------------------------------------------------------------------
 
 
+def _join_and_filter(stmt, df, catalog, time_col):
+    """Apply the statement's joins (inner equi-joins; conditions from ON
+    or WHERE) and residual WHERE conjuncts to one frame. Fixed point over
+    the join list: a snowflake chain's parent may be listed after its
+    child, and the link column only appears once the parent merges."""
+    where_conjs = _split_and(stmt.where)
+    pending = list(stmt.joins)
+    while pending:
+        still = []
+        for j in pending:
+            other = catalog.get(j.table).frame
+            conds = _split_and(j.on) if j.on is not None else where_conjs
+            pair = None
+            for c in conds:
+                p = _equi_pair(c, df.columns, other.columns)
+                if p:
+                    pair = (c, p)
+                    break
+            if pair is None:
+                still.append(j)
+                continue
+            cond, (lcol, rcol) = pair
+            if j.on is None:
+                where_conjs.remove(cond)
+            how = "left" if j.kind == "left" else "inner"
+            df = df.merge(other, left_on=lcol, right_on=rcol, how=how,
+                          suffixes=("", f"__{j.table}"))
+            if j.on is not None:
+                for extra in [c for c in _split_and(j.on) if c is not cond]:
+                    df = df[_eval_bool(extra, df, time_col)]
+        if len(still) == len(pending):
+            raise FallbackError(
+                f"no join condition for {still[0].table!r}")
+        pending = still
+
+    for c in where_conjs:
+        df = df[_eval_bool(c, df, time_col)]
+    return df
+
+
 def _aggregate(df, exprs, out_names, group_exprs, stmt, time_col):
     gkeys = {}
     gname_of = {}
@@ -153,7 +164,10 @@ def _aggregate(df, exprs, out_names, group_exprs, stmt, time_col):
             l_val = agg_series(e.left, sub)
             r_val = agg_series(e.right, sub)
             if e.op == "/":
-                # match the device path's ArithmeticPostAgg rule: x/0 -> 0
+                # NULL operand -> NULL (device: NaN propagates through
+                # the post-agg); else ArithmeticPostAgg rule x/0 -> 0
+                if pd.isna(l_val) or pd.isna(r_val):
+                    return np.nan
                 return float(l_val) / r_val if r_val else 0.0
             return _APPLY[e.op](l_val, r_val)
         if isinstance(e, Lit):
@@ -220,6 +234,338 @@ def _aggregate(df, exprs, out_names, group_exprs, stmt, time_col):
     return out[out_names].reset_index(drop=True)
 
 
+# ---------------------------------------------------------------------------
+# Chunked (streamed) fallback — bounded resident rows at SF scale.
+
+_FILL = "\0null"
+
+
+def _collect_agg_calls(e, into: dict):
+    if isinstance(e, FuncCall) and e.name in AGG_FUNCS:
+        into[_k(e)] = e
+        return
+    if isinstance(e, BinOp):
+        _collect_agg_calls(e.left, into)
+        _collect_agg_calls(e.right, into)
+    elif isinstance(e, FuncCall):
+        for a in e.args:
+            _collect_agg_calls(a, into)
+
+
+def _fill_strings(s: pd.Series) -> pd.Series:
+    if s.dtype == object or str(s.dtype).startswith(("str", "category")):
+        return s.fillna(_FILL)
+    return s
+
+
+def _execute_chunked(stmt: SelectStmt, entry, catalog, config):
+    """Execute the fallback over streamed parquet row-group chunks:
+    partial aggregation per chunk + pandas merge of decomposable partial
+    states (sum/min/max/count as themselves, AVG as sum+rows, DISTINCT as
+    deduplicated (group, value) pairs) — the host-side mirror of the
+    device path's partial/final aggregate split (SURVEY.md §3.5 P2). A
+    non-aggregate result larger than fallback_scan_row_cap refuses with a
+    clear error instead of exhausting host RAM."""
+    time_col = entry.time_column
+    batch = config.fallback_chunk_batch_rows
+    chunks = entry.iter_chunks(batch)
+
+    out_names, exprs = [], []
+    star_expand = any(isinstance(e, Col) and e.name == "*"
+                      for e, _ in stmt.projections)
+    first = None
+    if star_expand:
+        first = next(chunks, None)
+        if first is None:
+            return pd.DataFrame()
+    for e, alias in stmt.projections:
+        if isinstance(e, Col) and e.name == "*":
+            base = _join_and_filter(stmt, first.iloc[:0], catalog, time_col)
+            for c in base.columns:
+                out_names.append(c)
+                exprs.append(Col(c))
+            continue
+        out_names.append(alias or _auto_name(e))
+        exprs.append(e)
+    if first is not None:
+        import itertools
+        chunks = itertools.chain([first], chunks)
+
+    has_agg = any(_contains_agg(e) for e in exprs)
+    group_exprs = list(stmt.group_by)
+    if stmt.distinct and not has_agg and not group_exprs:
+        group_exprs = list(exprs)
+
+    if group_exprs or has_agg:
+        return _chunked_aggregate(stmt, chunks, exprs, out_names,
+                                  group_exprs, catalog, time_col,
+                                  pair_cap=config.fallback_scan_row_cap)
+    return _chunked_scan(stmt, chunks, exprs, out_names, catalog,
+                         time_col, config)
+
+
+def _chunked_scan(stmt, chunks, exprs, out_names, catalog, time_col,
+                  config):
+    order_exprs = {}
+    for i, item in enumerate(stmt.order_by):
+        name = _auto_name(item.expr)
+        if name not in out_names:
+            order_exprs[f"__s{i}"] = item.expr
+    need = None
+    if stmt.limit is not None and not stmt.order_by:
+        need = stmt.offset + stmt.limit
+    # unordered LIMIT: SQL allows any rows, but keep determinism within
+    # the streamed window by sorting it on time (the whole-frame path
+    # sorts the WHOLE table on time — streaming the whole table to honor
+    # that exactly would defeat the early stop, so the guarantee here is
+    # "time-sorted within the first chunks that satisfy the limit")
+    time_sort = need is not None and time_col is not None
+    parts, total = [], 0
+    for chunk in chunks:
+        df = _join_and_filter(stmt, chunk, catalog, time_col)
+        if not len(df):
+            continue
+        part = pd.DataFrame(
+            {n: _eval(e, df, time_col) for n, e in zip(out_names, exprs)})
+        for col, e in order_exprs.items():
+            part[col] = _eval(e, df, time_col).to_numpy()
+        if time_sort and time_col in df.columns:
+            part["__t"] = df[time_col].to_numpy()
+        parts.append(part.reset_index(drop=True))
+        total += len(part)
+        if need is not None and total >= need:
+            break
+        if total > config.fallback_scan_row_cap:
+            raise FallbackError(
+                f"chunked fallback result exceeds fallback_scan_row_cap="
+                f"{config.fallback_scan_row_cap} rows; narrow the query "
+                "or raise the cap")
+    if not parts:
+        return pd.DataFrame(columns=out_names)
+    out = pd.concat(parts, ignore_index=True)
+    if stmt.order_by:
+        keys = [(_auto_name(i.expr) if _auto_name(i.expr) in out_names
+                 else f"__s{j}") for j, i in enumerate(stmt.order_by)]
+        out = out.sort_values(
+            keys, ascending=[not i.descending for i in stmt.order_by],
+            kind="stable")
+    elif time_sort and "__t" in out.columns:
+        out = out.sort_values("__t", kind="stable")
+    lo = stmt.offset
+    hi = None if stmt.limit is None else lo + stmt.limit
+    return out[out_names].iloc[lo:hi].reset_index(drop=True)
+
+
+def _chunked_aggregate(stmt, chunks, exprs, out_names, group_exprs,
+                       catalog, time_col, pair_cap=20_000_000):
+    # every aggregate call reachable from projections / HAVING / ORDER BY
+    agg_calls: dict = {}
+    for e in exprs:
+        _collect_agg_calls(e, agg_calls)
+    if stmt.having is not None:
+        _collect_agg_calls(stmt.having, agg_calls)
+    for item in stmt.order_by:
+        _collect_agg_calls(item.expr, agg_calls)
+    specs = list(agg_calls.items())  # [(key, FuncCall)]
+
+    gcols = [f"__g{i}" for i in range(len(group_exprs))]
+    gname_of = {_k(g): n for g, n in zip(group_exprs, gcols)}
+    merge_ops: dict = {"__rows": "sum"}
+    distinct_keys = [k for k, e in specs if e.name in (
+        "count_distinct", "approx_count_distinct", "theta_sketch")]
+
+    def chunk_partial(df):
+        """One chunk -> (partials frame, {agg key: distinct-pairs frame})."""
+        work = {}
+        for g, n in zip(group_exprs, gcols):
+            work[n] = _fill_strings(_eval(g, df, time_col))
+        work["__rows"] = np.ones(len(df), np.int64)
+        dpairs = {}
+        for i, (k, e) in enumerate(specs):
+            if e.name in ("count_distinct", "approx_count_distinct",
+                          "theta_sketch"):
+                cols = dict(
+                    {n: work[n] for n in gcols},
+                    **{f"v{j}": _eval_agg_input(a, df, time_col)
+                       for j, a in enumerate(e.args)})
+                p = pd.DataFrame(cols).dropna(
+                    subset=[f"v{j}" for j in range(len(e.args))])
+                dpairs[k] = p.drop_duplicates()
+                continue
+            if e.name == "count" and not e.args:
+                continue  # __rows covers it
+            v = _eval_agg_input(e.args[0], df, time_col)
+            if e.name == "count":
+                work[f"p{i}"] = v.notna().astype(np.int64)
+                merge_ops[f"p{i}"] = "sum"
+            elif e.name in ("sum", "avg"):
+                work[f"p{i}"] = v
+                merge_ops[f"p{i}"] = "sum"
+            elif e.name in ("min", "max"):
+                work[f"p{i}"] = v
+                merge_ops[f"p{i}"] = e.name
+            else:
+                raise FallbackError(
+                    f"aggregate {e.name!r} has no chunked fallback")
+        wf = pd.DataFrame(work, index=df.index)
+        if gcols:
+            return (wf.groupby(gcols, sort=False, dropna=False)
+                      .agg(merge_ops).reset_index(), dpairs)
+        return wf.agg(merge_ops).to_frame().T, dpairs
+
+    partials: list = []
+    pair_parts: dict = {k: [] for k in distinct_keys}
+
+    def compact():
+        nonlocal partials
+        if len(partials) > 1:
+            cat = pd.concat(partials, ignore_index=True)
+            if gcols:
+                partials = [cat.groupby(gcols, sort=False, dropna=False)
+                               .agg(merge_ops).reset_index()]
+            else:
+                partials = [cat.agg(merge_ops).to_frame().T]
+        for k in distinct_keys:
+            if len(pair_parts[k]) > 1:
+                pair_parts[k] = [pd.concat(pair_parts[k],
+                                           ignore_index=True)
+                                 .drop_duplicates()]
+            if pair_parts[k] and len(pair_parts[k][0]) > pair_cap:
+                # COUNT(DISTINCT high-cardinality) needs the full value
+                # set; refusing with a clear error beats an OOM (the
+                # "never an error" property is already forfeit either
+                # way — this makes the failure legible and bounded)
+                raise FallbackError(
+                    "chunked fallback COUNT(DISTINCT) exceeds "
+                    f"fallback_scan_row_cap={pair_cap} distinct pairs; "
+                    "use approx_count_distinct on the device path or "
+                    "raise the cap")
+
+    pending_rows = 0
+    empty_proto = None   # 0-row joined frame with the real schema
+    for chunk in chunks:
+        df = _join_and_filter(stmt, chunk, catalog, time_col)
+        if empty_proto is None:
+            empty_proto = df.iloc[0:0]
+        if not len(df):
+            continue
+        part, dpairs = chunk_partial(df)
+        partials.append(part)
+        for k, p in dpairs.items():
+            pair_parts[k].append(p)
+        # distinct pairs count toward the compaction trigger too — a
+        # high-cardinality DISTINCT grows pairs by up to a whole chunk
+        # while adding one partial row, and the pair cap is enforced
+        # inside compact()
+        pending_rows += len(part) + sum(len(p) for p in dpairs.values())
+        if pending_rows > (1 << 20):
+            compact()
+            pending_rows = 0
+    if not partials:
+        if gcols:
+            return pd.DataFrame(columns=out_names)
+        # global aggregate over zero matching rows: delegate to the
+        # in-memory aggregator on a 0-row frame CARRYING THE REAL SCHEMA
+        # so column references resolve (count->0, sum->0, min->NA)
+        if empty_proto is None:
+            empty_proto = pd.DataFrame(columns=out_names)
+        return _aggregate(empty_proto, exprs, out_names, [], stmt,
+                          time_col)
+    compact()
+    merged = partials[0]
+
+    def _norm_key(t):
+        """NaN group-key slots normalize to the string fill so dict
+        lookups hit (nan != nan would always miss)."""
+        return tuple(_FILL if (not isinstance(v, str) and pd.isna(v))
+                     else v for v in t)
+
+    # distinct counts per group: {agg key: {group tuple: count}}
+    dcounts: dict = {}
+    for k in distinct_keys:
+        pairs = pair_parts[k][0] if pair_parts[k] else \
+            pd.DataFrame(columns=gcols)
+        if gcols:
+            sizes = pairs.groupby(gcols, sort=False, dropna=False).size()
+            dcounts[k] = {_norm_key(kk if isinstance(kk, tuple)
+                                    else (kk,)): int(v)
+                          for kk, v in sizes.items()}
+        else:
+            dcounts[k] = {(): len(pairs)}
+
+    spec_col = {k: f"p{i}" for i, (k, _) in enumerate(specs)}
+
+    def merged_agg(e, row, gkey):
+        k = _k(e)
+        if e.name in ("count_distinct", "approx_count_distinct",
+                      "theta_sketch"):
+            return dcounts[k].get(_norm_key(gkey), 0)
+        if e.name == "count" and not e.args:
+            return int(row["__rows"])
+        if e.name == "count":
+            return int(row[spec_col[k]])
+        if e.name == "avg":
+            r = int(row["__rows"])
+            return row[spec_col[k]] / r if r else np.nan
+        return row[spec_col[k]]
+
+    def ev_merged(e, row, gkey):
+        if isinstance(e, Lit):
+            return e.value
+        if isinstance(e, FuncCall) and e.name in AGG_FUNCS:
+            return merged_agg(e, row, gkey)
+        k = _k(e)
+        if k in gname_of:
+            v = row[gname_of[k]]
+            return None if (isinstance(v, str) and v == _FILL) else v
+        if isinstance(e, BinOp):
+            l_val = ev_merged(e.left, row, gkey)
+            r_val = ev_merged(e.right, row, gkey)
+            if e.op == "/":
+                # NULL operand -> NULL (device: NaN propagates through
+                # the post-agg); else ArithmeticPostAgg rule x/0 -> 0
+                if pd.isna(l_val) or pd.isna(r_val):
+                    return np.nan
+                return float(l_val) / r_val if r_val else 0.0
+            return _APPLY[e.op](l_val, r_val)
+        raise FallbackError(
+            f"non-aggregate projection {e!r} with GROUP BY")
+
+    order_cols, order_exprs, ascending = [], {}, []
+    for i, item in enumerate(stmt.order_by):
+        name = _auto_name(item.expr)
+        if name in out_names:
+            order_cols.append(name)
+        else:
+            col = f"__s{i}"
+            order_cols.append(col)
+            order_exprs[col] = item.expr
+        ascending.append(not item.descending)
+
+    rows = []
+    if gcols:
+        merged = merged.sort_values(gcols, kind="stable")
+    for _, row in merged.iterrows():
+        gkey = tuple(row[c] for c in gcols)
+        rec = {n: ev_merged(e, row, gkey)
+               for n, e in zip(out_names, exprs)}
+        if stmt.having is not None and not _having_ok(
+                stmt.having, None, rec, time_col,
+                lambda x, sub, _r=row, _g=gkey: ev_merged(x, _r, _g)):
+            continue
+        for col, e in order_exprs.items():
+            rec[col] = ev_merged(e, row, gkey)
+        rows.append(rec)
+    out = pd.DataFrame(rows, columns=out_names + list(order_exprs))
+    if order_cols:
+        out = out.sort_values(order_cols, ascending=ascending,
+                              kind="stable", key=_null_low_key)
+    out = out[out_names].reset_index(drop=True)
+    lo = stmt.offset
+    hi = None if stmt.limit is None else lo + stmt.limit
+    return out.iloc[lo:hi].reset_index(drop=True)
+
+
 def _null_low_key(s: pd.Series) -> pd.Series:
     """Sort key matching the device path's null placement: null == ""
     for string dims (Druid's legacy null ordering) and -inf for numeric
@@ -249,22 +595,42 @@ def _null_low_key(s: pd.Series) -> pd.Series:
     return s
 
 
+_CMP_OPS = ("==", "!=", "<", "<=", ">", ">=")
+
+
 def _having_ok(having, sub, rec, time_col, agg_series) -> bool:
+    """NULL-aggregate semantics match the device path (results.eval_having):
+    NULL aggregates surface there as NaN in float64 arrays, so every
+    comparison against them is False and NOT flips that to True. Here the
+    NULL may be pd.NA instead of NaN, so comparisons collapse an NA operand
+    to False explicitly; arithmetic propagates NA; a bare NA truth value at
+    the top is False."""
     e = having
 
     def ev(x):
         if isinstance(x, Lit):
             return x.value
+        if isinstance(x, BinOp) and (
+                x.op in _CMP_OPS or x.op in ("&&", "||")):
+            lv, rv = ev(x.left), ev(x.right)
+            if x.op in _CMP_OPS and (pd.isna(lv) or pd.isna(rv)):
+                return False
+            if x.op in ("&&", "||"):
+                lv = False if pd.isna(lv) else bool(lv)
+                rv = False if pd.isna(rv) else bool(rv)
+            return _APPLY[x.op](lv, rv)
+        if isinstance(x, FuncCall) and x.name == "not":
+            v = ev(x.args[0])
+            return True if pd.isna(v) else not v
         if _contains_agg(x):
             return agg_series(x, sub)
         if isinstance(x, Col):
             return rec.get(x.name)
         if isinstance(x, BinOp):
             return _APPLY[x.op](ev(x.left), ev(x.right))
-        if isinstance(x, FuncCall) and x.name == "not":
-            return not ev(x.args[0])
         raise FallbackError(f"cannot evaluate HAVING {x!r}")
-    return bool(ev(e))
+    v = ev(e)
+    return False if pd.isna(v) else bool(v)
 
 
 # ---------------------------------------------------------------------------
