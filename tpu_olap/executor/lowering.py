@@ -279,11 +279,11 @@ def _lower_agg(query, table, config) -> PhysicalPlan:
         sparse=sparse, make_sparse_kernel=make_sparse_kernel if sparse
         else None)
     if not sparse:
-        _maybe_use_pallas(plan, query, table, config, filter_fn)
+        _maybe_use_pallas(plan, query, table, config, filter_fn, imask_fn)
     return plan
 
 
-def _maybe_use_pallas(plan, query, table, config, filter_fn):
+def _maybe_use_pallas(plan, query, table, config, filter_fn, imask_fn=None):
     """Swap the generic jnp kernel for the fused Pallas one-hot MXU reduce
     when the plan fits its envelope (kernels.pallas_reduce). The numpy
     ("cpu" platform) path never uses it; "auto" additionally requires the
@@ -302,13 +302,14 @@ def _maybe_use_pallas(plan, query, table, config, filter_fn):
         return
     from tpu_olap.kernels import pallas_reduce
 
-    reason = pallas_reduce.eligible(query, plan, table, config)
+    reason = pallas_reduce.eligible(query, plan, table, config, filter_fn)
     if reason is not None:
         plan.pallas_reason = reason
         return
     plan.kernel = pallas_reduce.build_kernel(plan, table, config, filter_fn,
-                                             interpret=not on_tpu)
-    plan.statics = plan.statics + ("pallas",)
+                                             interpret=not on_tpu,
+                                             imask_fn=imask_fn)
+    plan.statics = plan.statics + ("pallas", config.pallas_k_per_block)
     plan.pallas_reason = None
 
 

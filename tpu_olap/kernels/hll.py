@@ -74,6 +74,11 @@ def hll_estimate(registers, xp=np, float_dtype=np.float64):
     device keeps the per-query host fetch to one small buffer."""
     ft = np.dtype(float_dtype).type
     regs = xp.asarray(registers).astype(float_dtype)
+    # clamp to the valid register range: padding/absent-group slots can
+    # carry negative sentinels (exchange-merge buffers), and 2^-(-x)
+    # overflows float for large x — those slots are masked downstream,
+    # but the warning (and inf) must not be produced at all
+    regs = xp.clip(regs, 0.0, 64.0)
     m = NUM_REGISTERS
     inv = xp.power(ft(2.0), -regs).sum(axis=-1)
     est = ft(_ALPHA * m * m) / inv

@@ -25,10 +25,20 @@ sum_j out[:, j] << 4j. Counts ride the same matmul as columns of ones.
 
 Eligibility (checked by `eligible()`, anything else falls back to the XLA
 scatter path — mirroring the planner's structural-fallback rule, SURVEY.md
-§2 property 2): granularity "all", no interval mask, dims lowered to
-codes/numeric-offset/remap (compare + small-table gather only), aggs are
-count / non-negative integer sums whose value bounds fit int32 (interval
-arithmetic over virtual-column exprs), no DOUBLE inputs, no float literals.
+§2 property 2): dims lowered to codes/numeric-offset/remap (compare +
+small-table gather only), aggs are count / integer sums whose value bounds
+fit int32 (interval arithmetic over virtual-column exprs), no DOUBLE
+inputs, no float or over-int32 constants *read inside the kernel*.
+
+Time handling (round-3 widening): granularity buckets and interval masks
+are computed OUTSIDE the kernel (plain XLA over the int64 time column —
+cheap elementwise work XLA fuses anyway) and enter the kernel as an int32
+bucket-id input folded into the mixed-radix key / ANDed into the validity
+mask. The int64-free kernel interior stays int32. Only a query that reads
+__time *inside* the kernel (a filter or aggregate on raw time) is
+ineligible. Group spaces past pallas_k_per_block tile over a second grid
+axis (K-blocks × row-blocks), so K is bounded by pallas_group_cap, not by
+one onehot tile.
 """
 
 from __future__ import annotations
@@ -83,23 +93,95 @@ class _Ineligible(Exception):
     pass
 
 
+def kernel_columns(plan) -> tuple:
+    """Physical columns read INSIDE the kernel: filter + agg + dim inputs
+    expanded through virtual columns. Excludes __time uses that the host
+    wrapper precomputes (bucket ids, interval mask) — if __time appears
+    here, the query reads raw time in-kernel and is ineligible (the kernel
+    interior is int32-only)."""
+    q = plan.query
+    cols: set = set()
+    if q.filter is not None:
+        cols |= q.filter.columns()
+    for p in plan.agg_plans:
+        cols |= set(p.fields)
+    for dp in plan.dim_plans:
+        if dp.source_col:
+            cols.add(dp.source_col)
+
+    def agg_filter_cols(spec):
+        if isinstance(spec, A.FilteredAggregation):
+            return spec.filter.columns() | agg_filter_cols(spec.aggregator)
+        return set()
+
+    for a in q.aggregations:
+        cols |= agg_filter_cols(a)
+    phys: set = set()
+    for c in cols:
+        phys |= (plan.virtual_exprs[c].columns()
+                 if c in plan.virtual_exprs else {c})
+    return tuple(sorted(phys))
+
+
+class _ConstTracker:
+    """consts-dict wrapper recording which ConstPool names the kernel's
+    compiled closures actually read (filters, dim id maps, agg filters) —
+    only those enter the Pallas kernel and must fit int32; host-side
+    consts (interval edges, bucket origins: int64 epoch millis) do not."""
+
+    def __init__(self, consts):
+        self._c = consts
+        self.used: set = set()
+
+    def __getitem__(self, k):
+        self.used.add(k)
+        return self._c[k]
+
+
+def traced_const_names(plan, table, filter_fn) -> list:
+    """Names of pool consts the kernel closures read, discovered by running
+    them once on a tiny all-zeros numpy environment (the closures are
+    xp-generic and total on any int input). Memoized on the plan —
+    eligible() and build_kernel() both need it for the same lowering."""
+    cached = getattr(plan, "_pallas_const_names", None)
+    if cached is not None:
+        return cached
+    n = 8
+    kcols = kernel_columns(plan)
+    cols = {c: np.zeros(n, np.int64) for c in kcols}
+    nulls = {c: np.zeros(n, bool) for c in plan.null_cols if c in kcols}
+    materialize_virtuals(plan.virtual_exprs, cols, nulls, np,
+                         wide_ints=False)
+    env = {"cols": cols, "nulls": nulls}
+    tc = _ConstTracker(plan.pool.consts)
+    if filter_fn is not None:
+        filter_fn(env, tc)
+    for dp in plan.dim_plans:
+        dp.ids(env, tc, np)
+    for p in plan.agg_plans:
+        if p.filter_fn is not None:
+            p.filter_fn(env, tc)
+    plan._pallas_const_names = sorted(tc.used)
+    return plan._pallas_const_names
+
+
 def column_bounds(plan, table) -> dict:
-    """Integer [min, max] of every numeric column the plan reads; raises
+    """Integer [min, max] of every numeric column the kernel reads; raises
     _Ineligible for DOUBLE columns or ranges that cannot load as int32.
     Memoized on the table (segments are immutable after ingest), so
     repeated queries over the same columns pay the metadata scan once."""
     cache = getattr(table, "_pallas_bounds_cache", None)
     if cache is None:
         cache = table._pallas_bounds_cache = {}
-    key = plan.columns
+    key = kernel_columns(plan)
     cached = cache.get(key)
     if cached is not None:
         if isinstance(cached, _Ineligible):
             raise cached
         return cached
-    md = table.column_metadata(set(plan.columns) or None)
+    md = table.column_metadata(set(key) or None)
     bounds = {}
-    for c in plan.columns:
+    for c in key:
         typ = table.schema[c]
         if typ is ColumnType.STRING:
             continue
@@ -177,14 +259,13 @@ def plan_layout(agg_plans, sum_bounds) -> PallasLayout:
     return PallasLayout(h, 0, tuple(slots))
 
 
-def eligible(query, plan, table, config) -> str | None:
+def eligible(query, plan, table, config, filter_fn=None) -> str | None:
     """None if the plan can run on the Pallas kernel, else the reason."""
     if plan.kind != "agg":
         return "not an aggregate plan"
-    if plan.bucket_plan.kind != "all":
-        return "granularity is not 'all'"
-    if TIME_COLUMN in plan.columns:
-        return "needs the time column (interval mask)"
+    kcols = kernel_columns(plan)
+    if TIME_COLUMN in kcols:
+        return "raw __time read inside the kernel"
     if plan.total_groups > config.pallas_group_cap:
         return (f"group space {plan.total_groups} exceeds pallas cap "
                 f"{config.pallas_group_cap}")
@@ -236,7 +317,8 @@ def eligible(query, plan, table, config) -> str | None:
         if b[1] - b[0] > MAX_VALUE:
             return f"sum input {f!r} span {b} exceeds int32"
 
-    for name, v in plan.pool.consts.items():
+    for name in traced_const_names(plan, table, filter_fn):
+        v = plan.pool.consts[name]
         if v.dtype.kind == "f":
             return f"float literal const {name}"
         if v.dtype.kind == "i" and v.size and (
@@ -245,11 +327,15 @@ def eligible(query, plan, table, config) -> str | None:
     return None
 
 
-def build_kernel(plan, table, config, filter_fn, interpret: bool):
+def build_kernel(plan, table, config, filter_fn, interpret: bool,
+                 imask_fn=None):
     """The Pallas replacement for lowering's generic agg kernel closure.
 
     Same contract: fn(env, valid, seg_mask, consts) -> partial dict with
-    "_rows" plus one int64 [K] array per aggregation.
+    "_rows" plus one int64 [K] array per aggregation. Interval masks and
+    granularity bucket ids are evaluated on the int64 time column OUTSIDE
+    the pallas_call (plain fused XLA) and enter as mask / int32 key input;
+    group spaces wider than pallas_k_per_block tile over grid axis 0.
     """
     import jax
     import jax.numpy as jnp
@@ -263,18 +349,24 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool):
     dim_plans = plan.dim_plans
     agg_plans = plan.agg_plans
     vexprs = plan.virtual_exprs
+    bucket_plan = plan.bucket_plan
+    has_buckets = bucket_plan.kind != "all"
     block_rows = table.block_rows
     rb = min(block_rows, config.pallas_rows_per_block)
+    KB = min(K, config.pallas_k_per_block)
+    n_kb = -(-K // KB)
+    K_pad = n_kb * KB
 
-    const_names = sorted(plan.pool.consts)
-    col_names = list(plan.columns)
+    const_names = traced_const_names(plan, table, filter_fn)
+    col_names = [c for c in plan.columns if c != TIME_COLUMN]
 
     def make_kernel_fn(null_names):
         def kernel_fn(*refs):
-            (col_refs, null_refs, valid_ref, const_refs,
-             out_ref) = _split_refs(refs, len(col_names), len(null_names),
-                                    len(const_names))
-            step = pl.program_id(0)
+            (col_refs, bucket_refs, null_refs, valid_ref, const_refs,
+             out_ref) = _split_refs(refs, len(col_names), has_buckets,
+                                    len(null_names), len(const_names))
+            kb = pl.program_id(0)
+            step = pl.program_id(1)
 
             env = {"cols": {}, "nulls": {}}
             for name, r in zip(col_names, col_refs):
@@ -296,17 +388,18 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool):
             if filter_fn is not None:
                 mask = mask & filter_fn(env, consts)
 
-            # mixed-radix dense group key [rb]
-            key = None
+            # mixed-radix dense group key [rb]; the precomputed granularity
+            # bucket id is the most-significant digit (radix sizes[0])
+            key = bucket_refs[0][0, :] if has_buckets else None
             for dp, size in zip(dim_plans, sizes[1:]):
                 i = dp.ids(env, consts, jnp).astype(jnp.int32)
                 key = i if key is None else key * jnp.int32(size) + i
             if key is None:
                 key = jnp.zeros((rb,), jnp.int32)
 
-            # transposed masked one-hot [K, rb] — built directly in K-major
-            # orientation so every op stays 2-D (no big relayouts)
-            kk = jax.lax.broadcasted_iota(jnp.int32, (K, rb), 0)
+            # transposed masked one-hot [KB, rb] for this K-block — built
+            # directly in K-major orientation so every op stays 2-D
+            kk = jax.lax.broadcasted_iota(jnp.int32, (KB, rb), 0) + kb * KB
             onehot = ((kk == key[None, :]) & mask[None, :]).astype(jnp.bfloat16)
 
             # value planes [H_pad, rb]
@@ -342,22 +435,34 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool):
 
             @pl.when(step == 0)
             def _():
-                out_ref[:, :] = jnp.zeros((K, H_pad), jnp.int32)
+                out_ref[:, :] = jnp.zeros((KB, H_pad), jnp.int32)
             out_ref[:, :] += partial
         return kernel_fn
 
     def row_spec():
-        return pl.BlockSpec((1, rb), lambda i: (0, i))
+        return pl.BlockSpec((1, rb), lambda kb, i: (0, i))
 
     def const_spec(n):
-        return pl.BlockSpec((1, n), lambda i: (0, 0))
+        return pl.BlockSpec((1, n), lambda kb, i: (0, 0))
 
     def fn(env, valid, seg_mask, consts):
         n_segments = valid.shape[0]
         n = n_segments * block_rows
-        grid = n // rb
-        null_names = sorted(env["nulls"])
-        mask2 = (valid & seg_mask[:, None]).reshape(1, n)
+        grid_rows = n // rb
+        null_names = sorted(c for c in env["nulls"] if c != TIME_COLUMN)
+        mask = (valid & seg_mask[:, None]).reshape(-1)
+        bucket_in = []
+        if imask_fn is not None or has_buckets:
+            flat_env = {
+                "cols": {c: a.reshape(-1) for c, a in env["cols"].items()},
+                "nulls": {c: a.reshape(-1)
+                          for c, a in env["nulls"].items()}}
+            if imask_fn is not None:
+                mask = mask & imask_fn(flat_env, consts)
+            if has_buckets:
+                b = bucket_plan.ids(flat_env["cols"][TIME_COLUMN], consts)
+                bucket_in.append(b.astype(jnp.int32).reshape(1, n))
+        mask2 = mask.reshape(1, n)
         col_in = [_narrow(env["cols"][c].reshape(1, n), jnp)
                   for c in col_names]
         null_in = [env["nulls"][c].reshape(1, n) for c in null_names]
@@ -366,15 +471,17 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool):
 
         out = pl.pallas_call(
             make_kernel_fn(null_names),
-            grid=(grid,),
+            grid=(n_kb, grid_rows),
             in_specs=([row_spec() for _ in col_in]
+                      + [row_spec() for _ in bucket_in]
                       + [row_spec() for _ in null_in]
                       + [row_spec()]
                       + [const_spec(c.shape[1]) for c in const_in]),
-            out_specs=pl.BlockSpec((K, H_pad), lambda i: (0, 0)),
-            out_shape=jax.ShapeDtypeStruct((K, H_pad), jnp.int32),
+            out_specs=pl.BlockSpec((KB, H_pad), lambda kb, i: (kb, 0)),
+            out_shape=jax.ShapeDtypeStruct((K_pad, H_pad), jnp.int32),
             interpret=interpret,
-        )(*col_in, *null_in, mask2, *const_in)
+        )(*col_in, *bucket_in, *null_in, mask2, *const_in)
+        out = out[:K]
 
         res = {"_rows": out[:, layout.rows_slot].astype(jnp.int64)}
         for p, (name, kind, start, n_planes, bias) in zip(agg_plans,
@@ -395,14 +502,17 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool):
     return fn
 
 
-def _split_refs(refs, n_cols, n_nulls, n_consts):
+def _split_refs(refs, n_cols, has_buckets, n_nulls, n_consts):
     refs = list(refs)
+    nb = 1 if has_buckets else 0
     cols = refs[:n_cols]
-    nulls = refs[n_cols:n_cols + n_nulls]
-    valid = refs[n_cols + n_nulls]
-    consts = refs[n_cols + n_nulls + 1:n_cols + n_nulls + 1 + n_consts]
+    buckets = refs[n_cols:n_cols + nb]
+    nulls = refs[n_cols + nb:n_cols + nb + n_nulls]
+    valid = refs[n_cols + nb + n_nulls]
+    consts = refs[n_cols + nb + n_nulls + 1:
+                  n_cols + nb + n_nulls + 1 + n_consts]
     out = refs[-1]
-    return cols, nulls, valid, consts, out
+    return cols, buckets, nulls, valid, consts, out
 
 
 def _narrow(x, jnp):
