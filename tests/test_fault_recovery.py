@@ -93,6 +93,50 @@ def test_deadline_falls_back():
     pd.testing.assert_frame_equal(got, ref.sql(SQL))
 
 
+def test_deadline_recovery_reaches_device_again():
+    """VERDICT round-2 task #5: after a timed-out query N, query N+1 must
+    re-probe the device, clear the wedge, and execute on the device path
+    again (no permanent engine-wide CPU downgrade). The injector wedges
+    exactly once."""
+    import time as _time
+
+    class WedgeOnce:
+        def __init__(self):
+            self.calls = 0
+
+        def __call__(self, stage, attempt):
+            self.calls += 1
+            if self.calls == 1:
+                _time.sleep(1.5)
+
+    inj = WedgeOnce()
+    eng = Engine(EngineConfig(dispatch_retries=0))
+    eng.register_table("t", _df(), time_column="ts", block_rows=512)
+    eng.sql(SQL)  # warm the compile cache outside the deadline regime
+    eng.config.query_deadline_s = 0.4
+    eng.config.fault_injector = inj
+
+    got1 = eng.sql(SQL)  # wedges -> deadline -> fallback
+    assert "QueryDeadlineExceeded" in eng.last_plan.fallback_reason
+    assert eng.runner._wedged
+
+    got2 = eng.sql(SQL)  # reprobe succeeds -> device path again
+    assert eng.last_plan.fallback_reason is None
+    assert not eng.runner._wedged
+    assert any(h.get("device_probe_recovered") for h in eng.runner.history)
+    # the device-path record for query 2 exists and is not a fallback
+    assert eng.runner.history[-1]["query_type"] == "groupBy"
+    assert not eng.runner.history[-1].get("deadline_exceeded")
+
+    ref = Engine()
+    ref.register_table("t", _df(), time_column="ts", block_rows=512)
+    expect = ref.sql(SQL)
+    pd.testing.assert_frame_equal(got1, expect)
+    pd.testing.assert_frame_equal(got2, expect)
+    # let the abandoned thread drain so it cannot leak into other tests
+    _time.sleep(1.3)
+
+
 def test_shard_degradation():
     """Chip-loss analog: the 8-way mesh dispatch fails twice; recovery
     re-shards to 2 and the query still answers correctly."""

@@ -69,7 +69,7 @@ class QueryRunner:
         self._mesh = None
         self._active_shards = config.num_shards if config else None
         self._last_metrics: dict = {}
-        self._deadline_pool = None
+        self._wedged = False   # a deadline expired; re-probe before trusting
         self.history: list = []
 
     @property
@@ -120,32 +120,93 @@ class QueryRunner:
     def execute(self, query, table) -> QueryResult:
         deadline = self.config.query_deadline_s
         if deadline is not None:
-            import concurrent.futures
-            import threading
-            if self._deadline_pool is None:
-                # one persistent worker: all deadline-mode dispatches run
-                # on a single thread, so an abandoned (timed-out) dispatch
-                # and the next query's dispatch can never mutate the
-                # runner's caches concurrently — the next query just
-                # queues behind the wedge and times out in turn
-                self._deadline_pool = concurrent.futures.ThreadPoolExecutor(
-                    max_workers=1, thread_name_prefix="tpu-olap-dispatch")
-            abandoned = threading.Event()
-            fut = self._deadline_pool.submit(
-                self._execute, query, table, abandoned)
-            try:
-                return fut.result(timeout=deadline)
-            except concurrent.futures.TimeoutError:
-                abandoned.set()  # its history record is discarded
-                self.history.append({
-                    "query_type": query.query_type,
-                    "datasource": table.name,
-                    "deadline_exceeded": True,
-                    "total_ms": deadline * 1000,
-                })
-                raise QueryDeadlineExceeded(
-                    f"query exceeded deadline of {deadline}s") from None
+            if self._wedged:
+                # a previous dispatch timed out and was abandoned; before
+                # trusting the device again, prove it answers a trivial
+                # computation (the analog of the reference re-resolving a
+                # live broker after task kill, SURVEY.md §3.5/§6). Still
+                # dead -> fail fast so the engine keeps falling back
+                # without stacking another full deadline wait.
+                self._reprobe_device(deadline)
+            return self._run_with_deadline(query, table, deadline)
         return self._execute(query, table)
+
+    def _run_with_deadline(self, query, table, deadline: float):
+        """Dispatch on a fresh daemon thread, abandoning it on expiry.
+
+        An abandoned dispatch cannot be interrupted mid-XLA-computation;
+        it finishes (or hangs) in the background while later queries run
+        on new threads. Shared cache dicts tolerate that concurrency:
+        individual dict ops are atomic, structural rebuilds snapshot
+        first (clear_cache), and a stale entry written by an abandoned
+        thread after a recovery purge costs at most one retried dispatch
+        (the _dispatch retry purges again) — mirroring the reference,
+        where a killed Spark task's Druid query keeps running server-side
+        while the retry proceeds."""
+        import threading
+        box: dict = {}
+        abandoned = threading.Event()
+
+        def work():
+            try:
+                box["res"] = self._execute(query, table, abandoned)
+            except BaseException as e:  # noqa: BLE001 - relayed to caller
+                box["err"] = e
+
+        t = threading.Thread(target=work, daemon=True,
+                             name="tpu-olap-dispatch")
+        t.start()
+        t.join(deadline)
+        if t.is_alive():
+            abandoned.set()  # its history record is discarded
+            self._wedged = True
+            self.history.append({
+                "query_type": query.query_type,
+                "datasource": table.name,
+                "deadline_exceeded": True,
+                "total_ms": deadline * 1000,
+            })
+            raise QueryDeadlineExceeded(
+                f"query exceeded deadline of {deadline}s") from None
+        if "err" in box:
+            raise box["err"]
+        return box["res"]
+
+    def _reprobe_device(self, deadline: float):
+        """Post-wedge health check: a trivial device round-trip under the
+        deadline. Success clears the wedge and purges device caches (the
+        hang may have been a device reset poisoning buffers); failure
+        raises immediately."""
+        import threading
+        ok = threading.Event()
+
+        def work():
+            try:
+                if self.config.platform != "cpu":
+                    import jax.numpy as jnp
+                    jnp.ones((8,), jnp.int32).sum().block_until_ready()
+                ok.set()
+            except Exception:
+                pass
+
+        t = threading.Thread(target=work, daemon=True,
+                             name="tpu-olap-probe")
+        t.start()
+        t.join(deadline)
+        if not ok.is_set():
+            self.history.append({"device_probe_failed": True})
+            raise QueryDeadlineExceeded(
+                "device still unresponsive after a deadline-expired query")
+        self._wedged = False
+        # purge device-resident DATA (buffers a reset would poison) but
+        # keep compiled executables — recompiling every template would eat
+        # the next query's deadline; if an executable is also poisoned,
+        # the _dispatch retry layer purges the table's full cache anyway
+        for ds in list(self._datasets.values()):
+            ds.evict()
+        self._datasets.clear()
+        self._arg_cache.clear()
+        self.history.append({"device_probe_recovered": True})
 
     def _execute(self, query, table, abandoned=None) -> QueryResult:
         t0 = time.perf_counter()
@@ -200,8 +261,10 @@ class QueryRunner:
     def clear_cache(self, table_name: str | None = None):
         """Evict device-resident columns (+ compiled programs if full clear).
         The analog of `CLEAR DRUID CACHE` (SURVEY.md §4.5)."""
+        # list() snapshots: an abandoned deadline thread may insert
+        # concurrently (see _run_with_deadline) — never iterate live dicts
         if table_name is None:
-            for ds in self._datasets.values():
+            for ds in list(self._datasets.values()):
                 ds.evict()
             self._datasets.clear()
             self._jit_cache.clear()
@@ -209,11 +272,11 @@ class QueryRunner:
             self._cap_hints.clear()
         elif table_name in self._datasets:
             self._datasets.pop(table_name).evict()
-            self._jit_cache = {k: v for k, v in self._jit_cache.items()
+            self._jit_cache = {k: v for k, v in list(self._jit_cache.items())
                                if k[0] != table_name}
-            self._arg_cache = {k: v for k, v in self._arg_cache.items()
+            self._arg_cache = {k: v for k, v in list(self._arg_cache.items())
                                if k[0] != table_name}
-            self._cap_hints = {k: v for k, v in self._cap_hints.items()
+            self._cap_hints = {k: v for k, v in list(self._cap_hints.items())
                                if k[0] != table_name}
 
     # ------------------------------------------------------------- dispatch
