@@ -38,11 +38,49 @@ from __future__ import annotations
 from dataclasses import dataclass
 from math import ceil, log2
 
-# coarse per-chip model constants (v5e-class)
+# coarse per-chip fallback constants (v5e-class guesses) — superseded by
+# fitted per-backend values from cost_calibration.json when present
+# (tools/calibrate_cost.py measures and writes them)
 SCAN_NS_PER_ROW_COL = 0.05     # fused filter+reduce, HBM-bound
 MERGE_NS_PER_BYTE = 0.05       # ICI allreduce per byte per hop (~20 GB/s)
 COLLECTIVE_LAT_US = 25.0       # per-hop collective launch latency
 GSPMD_OVERHEAD = 1.35          # generic partitioner vs hand-written merge
+
+_FALLBACKS = {
+    "scan_ns_per_row_col": SCAN_NS_PER_ROW_COL,
+    "merge_ns_per_byte": MERGE_NS_PER_BYTE,
+    "collective_lat_us": COLLECTIVE_LAT_US,
+    "gspmd_overhead": GSPMD_OVERHEAD,
+}
+_calibration_cache: dict | None = None
+
+
+def _calibration() -> dict:
+    """Fitted constants for the current backend, {} when never fitted."""
+    global _calibration_cache
+    if _calibration_cache is None:
+        import json
+        import os
+        path = os.path.join(os.path.dirname(__file__),
+                            "cost_calibration.json")
+        data = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                data = json.load(f)
+        _calibration_cache = data
+    import jax
+    return _calibration_cache.get(jax.default_backend(), {})
+
+
+def constants(config) -> dict:
+    """Resolve the four model constants: explicit config pin > fitted
+    calibration for this backend > coarse fallback."""
+    cal = _calibration()
+    out = {}
+    for name, fb in _FALLBACKS.items():
+        pinned = getattr(config, "cost_" + name, None)
+        out[name] = pinned if pinned is not None else cal.get(name, fb)
+    return out
 
 
 @dataclass(frozen=True)
@@ -102,23 +140,30 @@ def decide(plan, config, shards: int) -> CostDecision:
     n_cols = max(1, len(plan.columns))
     width = table_width_bytes(plan)
     table_bytes = groups * width
+    c = constants(config)
 
-    scan_us = rows * n_cols * SCAN_NS_PER_ROW_COL / 1000.0 / max(1, shards)
+    scan_us = (rows * n_cols * c["scan_ns_per_row_col"] / 1000.0
+               / max(1, shards))
     hops = max(1, ceil(log2(max(2, shards))))
-    merge_us = hops * (COLLECTIVE_LAT_US
-                       + table_bytes * MERGE_NS_PER_BYTE / 1000.0
+    merge_us = hops * (c["collective_lat_us"]
+                       + table_bytes * c["merge_ns_per_byte"] / 1000.0
                        * config.shard_merge_factor)
 
     if shards <= 1:
         return CostDecision("historicals", 1, rows, groups, table_bytes,
                             scan_us, 0.0, "single device")
+    if config.force_strategy is not None:
+        return CostDecision(config.force_strategy, shards, rows, groups,
+                            table_bytes, scan_us, merge_us,
+                            "forced by config")
     if not config.cost_model_enabled:
         return CostDecision("historicals", shards, rows, groups,
                             table_bytes, scan_us, merge_us,
                             "cost model disabled")
     # broker (GSPMD) wins when the explicit merge dwarfs its own scan —
     # the compiler can overlap/restructure what the fixed psum cannot
-    if merge_us > GSPMD_OVERHEAD * (scan_us + COLLECTIVE_LAT_US * hops):
+    if merge_us > c["gspmd_overhead"] * (scan_us
+                                         + c["collective_lat_us"] * hops):
         return CostDecision("broker", shards, rows, groups, table_bytes,
                             scan_us, merge_us,
                             "merge dominates scan; defer to partitioner")
