@@ -3,9 +3,11 @@ reference's target workload (BASELINE.json:2: SSB SF100 Q1.1–Q4.3) and the
 direct analog of its TPC-H-flavored star test fixture (SURVEY.md §5)."""
 
 from tpu_olap.bench.ssb import (QUERIES, denormalize, generate_tables,
-                                register_ssb, star_schema)
+                                register_ssb, register_ssb_parquet,
+                                star_schema, write_ssb_parquet)
 from tpu_olap.bench.parity import (assert_frame_parity, check_query,
                                    run_both)
 
 __all__ = ["QUERIES", "denormalize", "generate_tables", "register_ssb",
-           "star_schema", "assert_frame_parity", "check_query", "run_both"]
+           "register_ssb_parquet", "star_schema", "write_ssb_parquet",
+           "assert_frame_parity", "check_query", "run_both"]
