@@ -186,3 +186,71 @@ def test_exchange_scales_past_per_chip_budget():
     ga = mk("gather")
     ga.sql("SELECT k, sum(v) AS s FROM t GROUP BY k LIMIT 7")
     assert "sparse budget" in (ga.last_plan.fallback_reason or "")
+
+
+# Skewed-key exchange (VERDICT round-2 weak #8): every group key hashes to
+# ONE owner chip — the worst case for the D x budget capacity claim.
+
+def _fib_owner(ids: np.ndarray, shards: int) -> np.ndarray:
+    """numpy mirror of sharding._owner_of (Fibonacci multiplicative)."""
+    h = ids.astype(np.int64) * np.int64(-7046029254386353131)
+    h = (h >> np.int64(33)) & np.int64(0x7FFFFFFF)
+    return (h % np.int64(shards)).astype(np.int32)
+
+
+def _skewed_values(n_groups: int, shards: int = 8) -> np.ndarray:
+    """Values for a single numeric dim whose sparse keys (value+1, with 0
+    present as the min) all hash to owner(1)."""
+    cand = np.arange(1, 400_000, dtype=np.int64)
+    target = _fib_owner(np.array([1], np.int64), shards)[0]
+    sel = cand[_fib_owner(cand, shards) == target][:n_groups] - 1
+    assert sel.size == n_groups, "not enough same-owner candidates"
+    assert sel[0] == 0  # value 0 present -> ids are exactly value+1
+    return sel
+
+
+def _skewed_engine(values, rows_per_group=3, **kw):
+    vals = np.repeat(values, rows_per_group)
+    df = pd.DataFrame({
+        "ts": pd.to_datetime("2022-01-01")
+        + pd.to_timedelta(np.arange(len(vals)) % 9973, unit="s"),
+        "k": vals,
+        "v": np.ones(len(vals), dtype=np.int64),
+    })
+    eng = Engine(EngineConfig(dense_group_budget=64, num_shards=8,
+                              sparse_merge="exchange", **kw))
+    eng.register_table("t", df, time_column="ts", block_rows=512)
+    return eng
+
+
+SKEW_SQL = "SELECT k, sum(v) AS s, count(*) AS n FROM t GROUP BY k"
+
+
+def test_exchange_skewed_single_owner_parity():
+    """All keys on one owner: send buckets and the owner table must
+    absorb (or retry into) the full group count while 7 chips idle —
+    answers must still match the fallback exactly."""
+    eng = _skewed_engine(_skewed_values(1500))
+    check_query(eng, SKEW_SQL)
+    m = eng.history[-1]
+    assert m.get("sparse_merge") == "exchange"
+    # the single owner held every group, so the owner cap retried up to
+    # at least the full group count (not the uniform count/D estimate)
+    assert m["result_cap_owner"] >= 1500
+
+
+def test_exchange_skewed_overflow_falls_back_cleanly():
+    """Skewed groups beyond the per-chip budget: retries exhaust at the
+    clamp and the engine answers via structural fallback, never an
+    error (SURVEY.md §2 property 2)."""
+    eng = _skewed_engine(_skewed_values(1200), sparse_group_budget=512)
+    got = eng.sql(SKEW_SQL)
+    assert eng.last_plan.fallback_reason is not None
+    assert "sparse budget" in eng.last_plan.fallback_reason
+    ref = _skewed_engine(_skewed_values(1200), sparse_group_budget=512)
+    from tpu_olap.planner.fallback import execute_fallback
+    expect = execute_fallback(ref.planner.plan(SKEW_SQL).stmt,
+                              ref.catalog, ref.config)
+    a = got.sort_values("k").reset_index(drop=True)
+    b = expect.sort_values("k").reset_index(drop=True)
+    pd.testing.assert_frame_equal(a, b, check_dtype=False)
