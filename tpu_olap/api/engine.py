@@ -124,12 +124,20 @@ class Engine:
         star = star_schema
         if isinstance(star, dict):
             star = StarSchema.from_json(star)
+        if segments is not None:
+            segments.star = star  # FD-aware dim-domain restriction
         entry = TableEntry(name=name, segments=segments,
                            frame_source=frame_source,
                            time_column=time_column, star=star,
                            options=dict(options), **pq_fields)
         self.catalog.register(entry)
         return entry
+
+    def register_lookup(self, name: str, mapping: dict):
+        """Register a named lookup map (Druid lookup extraction fn). SQL
+        reaches it as LOOKUP(col, 'name') in projections, GROUP BY, and
+        filters (SURVEY.md §3.3 lookup extraction dims)."""
+        self.catalog.lookups[name] = {str(k): v for k, v in mapping.items()}
 
     # --------------------------------------------------------------- SQL
 
@@ -218,6 +226,23 @@ class Engine:
         with self.device_lock:
             return self.runner.execute(query, entry.segments)
 
+    def select_page(self, table: str, columns=None, page_size: int = 100,
+                    offset: int = 0, descending: bool = False,
+                    filter_spec=None, intervals=()):
+        """Paged Select (SURVEY.md §3.3 SelectSpec): fetch one page of
+        raw rows plus the paging offset to pass back for the next page.
+        Returns (rows, next_offset). The SQL spellings LIMIT/OFFSET map
+        to Scan; this is the resumable-cursor flavor."""
+        from tpu_olap.ir.query import SelectQuerySpec
+        q = SelectQuerySpec(
+            data_source=table, intervals=tuple(intervals),
+            filter=filter_spec,
+            dimensions=tuple(columns or ()), metrics=(),
+            page_size=page_size, paging_offset=offset,
+            descending=descending)
+        res = self.execute_ir(q)
+        return res.rows, offset + len(res.rows)
+
     # -------------------------------------------------------------- admin
 
     def clear_cache(self, table: str | None = None):
@@ -267,6 +292,9 @@ _EXPLAIN_RE = _re.compile(
 _EXEC_RE = _re.compile(
     r"^\s*on\s+druid\s+datasource\s+(\w+)\s+execute\s+query\s+"
     r"'(.+)'\s*;?\s*$", _re.I | _re.S)
+_SEARCH_RE = _re.compile(
+    r"^\s*search\s+druid\s+datasource\s+(\w+)\s+for\s+'((?:[^']|'')*)'"
+    r"(?:\s+in\s+([\w\s,]+?))?(?:\s+limit\s+(\d+))?\s*;?\s*$", _re.I)
 
 
 def _match_verb(query: str):
@@ -282,6 +310,13 @@ def _match_verb(query: str):
     if m:
         ds, body = m.group(1), m.group(2).replace("''", "'")
         return lambda eng: _run_passthrough(eng, ds, body)
+    m = _SEARCH_RE.match(query)
+    if m:
+        ds, pat = m.group(1), m.group(2).replace("''", "'")
+        dims = tuple(d.strip() for d in m.group(3).split(",")) \
+            if m.group(3) else ()
+        limit = int(m.group(4)) if m.group(4) else 1000
+        return lambda eng: _run_search_verb(eng, ds, pat, dims, limit)
     return None
 
 
@@ -302,3 +337,17 @@ def _run_passthrough(eng: Engine, datasource: str, body: str) -> pd.DataFrame:
     spec.setdefault("dataSource", datasource)
     res = eng.execute_ir(spec)
     return res.to_pandas()
+
+
+def _run_search_verb(eng: Engine, datasource: str, pattern: str,
+                     dims: tuple, limit: int) -> pd.DataFrame:
+    """SEARCH DRUID DATASOURCE t FOR 'pat' [IN d1, d2] [LIMIT n] — the
+    SQL spelling of SearchQuerySpec (SURVEY.md §3.3; VERDICT round-2
+    missing #6)."""
+    from tpu_olap.ir.query import SearchQueryContains, SearchQuerySpec
+    q = SearchQuerySpec(
+        data_source=datasource, intervals=(),
+        search_dimensions=dims,
+        query=SearchQueryContains(pattern, case_sensitive=False),
+        limit=limit)
+    return eng.execute_ir(q).to_pandas()

@@ -84,6 +84,9 @@ class TableEntry:
 class Catalog:
     def __init__(self):
         self._tables: dict[str, TableEntry] = {}
+        # registered lookup maps (Druid's lookup extraction fns): the
+        # SQL spelling LOOKUP(col, 'name') resolves through this
+        self.lookups: dict[str, dict] = {}
 
     def register(self, entry: TableEntry):
         self._tables[entry.name] = entry

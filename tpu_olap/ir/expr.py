@@ -107,6 +107,24 @@ class FuncCall(Expr):
         return FuncCall(d["name"], tuple(from_json("expr", a) for a in d["args"]))
 
 
+@dataclass(frozen=True)
+class Subquery(Expr):
+    """A nested SELECT used as a scalar or IN-list source. Never lowers
+    to the device IR (no to_json on purpose): the planner treats any
+    statement containing one as non-rewritable and the fallback
+    interpreter resolves it before evaluation — the analog of the
+    reference delegating to full Spark SQL for shapes outside the
+    rewrite rules (SURVEY.md §3.1)."""
+    stmt: object  # planner.sqlparse.SelectStmt | UnionStmt
+
+    def columns(self):
+        return set()  # correlated subqueries are not supported
+
+    def to_json(self):
+        # structural identity only (expr_key); never sent to a device
+        return {"type": "subquery", "stmt": repr(self.stmt)}
+
+
 # ---------------------------------------------------------------------------
 # Tiny recursive-descent parser for expression strings: "a * b + 2.5"
 

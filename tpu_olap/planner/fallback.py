@@ -14,11 +14,11 @@ import re
 import numpy as np
 import pandas as pd
 
-from tpu_olap.ir.expr import BinOp, Col, FuncCall, Lit
+from tpu_olap.ir.expr import BinOp, Col, FuncCall, Lit, Subquery
 from tpu_olap.planner.exprutil import (contains_agg as _contains_agg,
                                        expr_key as _k, render as _auto_name,
                                        split_and as _split_and)
-from tpu_olap.planner.sqlparse import AGG_FUNCS, SelectStmt
+from tpu_olap.planner.sqlparse import (AGG_FUNCS, SelectStmt, UnionStmt)
 from tpu_olap.segments.dictionary import _like_to_regex
 
 _TIME_FUNCS = {"year", "month", "day", "dayofmonth", "quarter"}
@@ -28,19 +28,28 @@ class FallbackError(Exception):
     pass
 
 
-def execute_fallback(stmt: SelectStmt, catalog, config) -> pd.DataFrame:
-    entry = catalog.get(stmt.table)
-    if entry.parquet_paths and entry._frame is None and \
-            (entry.parquet_rows or 0) > config.fallback_chunk_rows:
-        # SF-scale parquet table: stream row-group chunks instead of
-        # materializing one frame (SURVEY.md §2 property 2 at scale)
-        return _execute_chunked(stmt, entry, catalog, config)
-    df = entry.frame.copy()
-    time_col = entry.time_column
-    if time_col is not None and time_col in df.columns:
-        # match the accelerated path's deterministic time-sorted row order
-        # (segments are time-sorted, so unordered LIMIT picks the same rows)
-        df = df.sort_values(time_col, kind="stable")
+def execute_fallback(stmt, catalog, config) -> pd.DataFrame:
+    if isinstance(stmt, UnionStmt):
+        return _execute_union(stmt, catalog, config)
+    stmt = _resolve_subqueries(stmt, catalog, config)
+    if stmt.derived is not None:
+        # FROM (SELECT ...) alias: the derived result is the base frame
+        df = execute_fallback(stmt.derived, catalog, config)
+        time_col = None
+    else:
+        entry = catalog.get(stmt.table)
+        if entry.parquet_paths and entry._frame is None and \
+                (entry.parquet_rows or 0) > config.fallback_chunk_rows:
+            # SF-scale parquet table: stream row-group chunks instead of
+            # materializing one frame (SURVEY.md §2 property 2 at scale)
+            return _execute_chunked(stmt, entry, catalog, config)
+        df = entry.frame.copy()
+        time_col = entry.time_column
+        if time_col is not None and time_col in df.columns:
+            # match the accelerated path's deterministic time-sorted row
+            # order (segments are time-sorted, so unordered LIMIT picks
+            # the same rows)
+            df = df.sort_values(time_col, kind="stable")
 
     df = _join_and_filter(stmt, df, catalog, time_col)
 
@@ -85,6 +94,116 @@ def execute_fallback(stmt: SelectStmt, catalog, config) -> pd.DataFrame:
 
 
 # ---------------------------------------------------------------------------
+# Shapes outside the rewrite subset (UNION, derived tables, subqueries):
+# the reference handed these to full Spark SQL (SURVEY.md §3.1); here the
+# interpreter executes them compositionally.
+
+
+def _execute_union(stmt: UnionStmt, catalog, config) -> pd.DataFrame:
+    frames = [execute_fallback(p, catalog, config) for p in stmt.parts]
+    cols = list(frames[0].columns)
+    for f in frames[1:]:
+        if len(f.columns) != len(cols):
+            raise FallbackError(
+                f"UNION branches have {len(cols)} vs {len(f.columns)} "
+                "columns")
+    out = pd.concat([f.set_axis(cols, axis=1) for f in frames],
+                    ignore_index=True)
+    if not stmt.all:
+        out = out.drop_duplicates(ignore_index=True)
+    if stmt.order_by:
+        keys, ascending = [], []
+        for item in stmt.order_by:
+            name = _auto_name(item.expr)
+            if name not in cols:
+                raise FallbackError(
+                    f"UNION ORDER BY {name!r} is not an output column")
+            keys.append(name)
+            ascending.append(not item.descending)
+        out = out.sort_values(keys, ascending=ascending, kind="stable",
+                              key=_null_low_key)
+    lo = stmt.offset
+    hi = None if stmt.limit is None else lo + stmt.limit
+    return out.iloc[lo:hi].reset_index(drop=True)
+
+
+def _scalar_from(sub_df: pd.DataFrame):
+    if sub_df.shape[1] != 1 or len(sub_df) > 1:
+        raise FallbackError(
+            f"scalar subquery returned shape {sub_df.shape}; need 1x1")
+    if len(sub_df) == 0:
+        return None
+    v = sub_df.iloc[0, 0]
+    if pd.isna(v):
+        return None
+    return v.item() if hasattr(v, "item") else v
+
+
+def _resolve_subqueries(stmt: SelectStmt, catalog, config) -> SelectStmt:
+    """Replace Subquery nodes (scalar) and in_subquery calls (IN lists)
+    with literals by executing the nested statements, and LOOKUP(col,
+    'name') references with their registered map inlined (the evaluator
+    has no catalog access). Non-correlated only; the planner already
+    routed any statement containing one here."""
+    hit = False
+
+    def walk(e):
+        nonlocal hit
+        if e is None or isinstance(e, (Lit, Col)):
+            return e
+        if isinstance(e, Subquery):
+            hit = True
+            return Lit(_scalar_from(
+                execute_fallback(e.stmt, catalog, config)))
+        if isinstance(e, FuncCall) and e.name == "in_subquery":
+            hit = True
+            lhs = walk(e.args[0])
+            sub = execute_fallback(e.args[1].stmt, catalog, config)
+            if sub.shape[1] != 1:
+                raise FallbackError(
+                    f"IN subquery returned {sub.shape[1]} columns")
+            if len(sub) > config.fallback_scan_row_cap:
+                raise FallbackError(
+                    "IN subquery result exceeds fallback_scan_row_cap")
+            # one packed Lit holding every value — per-value Lit nodes
+            # would allocate millions of objects for big subqueries
+            vals = tuple(None if pd.isna(v)
+                         else (v.item() if hasattr(v, "item") else v)
+                         for v in sub.iloc[:, 0])
+            return FuncCall("in_list_packed", (lhs, Lit(vals)))
+        if isinstance(e, FuncCall) and e.name == "lookup" \
+                and len(e.args) == 2 and isinstance(e.args[1], Lit):
+            hit = True
+            mapping = catalog.lookups.get(e.args[1].value)
+            if mapping is None:
+                raise FallbackError(f"unknown lookup {e.args[1].value!r}")
+            return FuncCall("lookup_map",
+                            (walk(e.args[0]),
+                             Lit(tuple(sorted(mapping.items())))))
+        if isinstance(e, BinOp):
+            return BinOp(e.op, walk(e.left), walk(e.right))
+        if isinstance(e, FuncCall):
+            return FuncCall(e.name, tuple(walk(a) for a in e.args))
+        return e
+
+    import copy
+    projections = [(walk(e), a) for e, a in stmt.projections]
+    where = walk(stmt.where)
+    having = walk(stmt.having)
+    group_by = [walk(g) for g in stmt.group_by]
+    joins = [type(j)(j.table, walk(j.on), j.kind) for j in stmt.joins]
+    order_by = [type(o)(walk(o.expr), o.descending)
+                for o in stmt.order_by]
+    if not hit:
+        return stmt
+    out = copy.copy(stmt)
+    out.projections = projections
+    out.where = where
+    out.having = having
+    out.group_by = group_by
+    out.joins = joins
+    out.order_by = order_by
+    return out
 
 
 def _join_and_filter(stmt, df, catalog, time_col):
@@ -663,6 +782,13 @@ def _eval(e, df, time_col):
             raise FallbackError(f"unknown column {name!r}")
         return df[name]
     if isinstance(e, BinOp):
+        if e.op in ("==", "!=", "<", "<=", ">", ">=") and (
+                (isinstance(e.left, Lit) and e.left.value is None)
+                or (isinstance(e.right, Lit) and e.right.value is None)):
+            # comparison against a NULL literal (e.g. an empty scalar
+            # subquery inlined as Lit(None)) matches no rows — pandas
+            # would raise a TypeError on `series > None`
+            return pd.Series(np.zeros(len(df), bool), index=df.index)
         left = _eval(e.left, df, time_col)
         right = _eval(e.right, df, time_col)
         if e.op == "/":
@@ -698,9 +824,10 @@ def _eval(e, df, time_col):
             return (~v.astype(bool)) if hasattr(v, "astype") else (not v)
         if fn == "is_null":
             return _eval(e.args[0], df, time_col).isna()
-        if fn == "in_list":
+        if fn in ("in_list", "in_list_packed"):
             v = _eval(e.args[0], df, time_col)
-            vals = [a.value for a in e.args[1:]]
+            vals = list(e.args[1].value) if fn == "in_list_packed" \
+                else [a.value for a in e.args[1:]]
             has_null = any(x is None for x in vals)
             m = v.isin([x for x in vals if x is not None])
             if has_null:
@@ -743,6 +870,13 @@ def _eval(e, df, time_col):
             end = None if ln is None else start + ln
             return v.map(lambda x: None if pd.isna(x)
                          else str(x)[start:end])
+        if fn == "lookup_map":
+            v = _eval(e.args[0], df, time_col)
+            m = dict(e.args[1].value)
+            # Druid lookup semantics (retainMissingValue=false): values
+            # absent from the map (and nulls) become null
+            return v.map(lambda x: None if pd.isna(x)
+                         else m.get(str(x)))
         if fn == "regexp_extract":
             v = _eval(e.args[0], df, time_col)
             rx = re.compile(str(e.args[1].value))

@@ -89,7 +89,27 @@ class PlanResult:
                     "query": self.query.to_json(),
                     "outputs": [o.name for o in self.outputs]}
         return {"rewritten": False, "reason": self.fallback_reason,
-                "table": self.entry.name}
+                "table": self.entry.name if self.entry is not None
+                else self.stmt.table}
+
+
+def _stmt_has_subquery(stmt) -> bool:
+    from tpu_olap.ir.expr import Subquery
+
+    def walk(e):
+        if isinstance(e, Subquery):
+            return True
+        if isinstance(e, BinOp):
+            return walk(e.left) or walk(e.right)
+        if isinstance(e, FuncCall):
+            return e.name == "in_subquery" or any(walk(a) for a in e.args)
+        return False
+
+    exprs = ([e for e, _ in stmt.projections] + stmt.group_by
+             + [stmt.where, stmt.having]
+             + [o.expr for o in stmt.order_by]
+             + [j.on for j in stmt.joins])
+    return any(e is not None and walk(e) for e in exprs)
 
 
 class DruidPlanner:
@@ -102,6 +122,24 @@ class DruidPlanner:
 
     def plan(self, sql: str) -> PlanResult:
         stmt = parse_sql(sql)
+        # shapes outside the rewrite rules run on the fallback path (the
+        # reference delegated them to full Spark SQL, SURVEY.md §3.1) —
+        # declined here, never an error
+        from tpu_olap.planner.sqlparse import UnionStmt
+        if isinstance(stmt, UnionStmt):
+            entry = self.catalog.maybe(stmt.table)
+            return PlanResult(
+                stmt=stmt, entry=entry, sql=sql,
+                fallback_reason="UNION executes on the fallback path")
+        if stmt.derived is not None:
+            return PlanResult(
+                stmt=stmt, entry=None, sql=sql,
+                fallback_reason="derived table (FROM subquery) executes "
+                                "on the fallback path")
+        if _stmt_has_subquery(stmt):
+            return PlanResult(
+                stmt=stmt, entry=self.catalog.get(stmt.table), sql=sql,
+                fallback_reason="subquery executes on the fallback path")
         entry = self.catalog.get(stmt.table)
         result = PlanResult(stmt=stmt, entry=entry, sql=sql)
         try:
@@ -510,6 +548,19 @@ class _Rewriter:
                 raise RewriteError(
                     f"regexp_extract over non-string column {col!r}")
             return col, RegexExtractionFn(e.args[1].value)
+        if e.name == "lookup" and len(e.args) == 2 and \
+                isinstance(e.args[1], Lit) and isinstance(e.args[1].value,
+                                                          str):
+            from tpu_olap.ir.dimensions import LookupExtractionFn
+            lname = e.args[1].value
+            mapping = self.catalog.lookups.get(lname)
+            if mapping is None:
+                raise RewriteError(f"unknown lookup {lname!r}")
+            col = self._check_col(e.args[0].name)
+            if self._col_type(col) is not ColumnType.STRING:
+                raise RewriteError(
+                    f"lookup over non-string column {col!r}")
+            return col, LookupExtractionFn(tuple(mapping.items()))
         return None
 
     # ----------------------------------------------------------- aggregates

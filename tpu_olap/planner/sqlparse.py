@@ -20,7 +20,8 @@ from __future__ import annotations
 import re
 from dataclasses import dataclass, field
 
-from tpu_olap.ir.expr import BinOp, Col, Expr, FuncCall, Lit
+from tpu_olap.ir.expr import (BinOp, Col, Expr, FuncCall, Lit,
+                              Subquery)
 
 AGG_FUNCS = {"count", "sum", "min", "max", "avg", "count_distinct",
              "approx_count_distinct", "theta_sketch"}
@@ -37,7 +38,7 @@ _KEYWORDS = {
     "select", "from", "where", "group", "by", "having", "order", "limit",
     "offset", "as", "and", "or", "not", "between", "in", "like", "is",
     "null", "asc", "desc", "join", "inner", "left", "on", "distinct",
-    "case", "when", "then", "else", "end", "cast",
+    "case", "when", "then", "else", "end", "cast", "union", "all",
 }
 
 # CAST target type -> internal conversion function (kernels.exprs)
@@ -109,6 +110,26 @@ class SelectStmt:
     limit: int | None = None
     offset: int = 0
     distinct: bool = False
+    # FROM (SELECT ...) alias — the derived statement; `table` holds the
+    # alias. Fallback-only (the planner declines derived tables).
+    derived: object = None
+
+
+@dataclass
+class UnionStmt:
+    """SELECT ... UNION [ALL] SELECT ... — fallback-only (the reference
+    ran these through full Spark SQL; here the pandas interpreter
+    executes each branch and combines). ORDER/LIMIT/OFFSET written after
+    the last branch apply to the whole union, per standard SQL."""
+    parts: list                  # [SelectStmt]
+    all: bool = False
+    order_by: list = field(default_factory=list)
+    limit: int | None = None
+    offset: int = 0
+
+    @property
+    def table(self) -> str:
+        return self.parts[0].table
 
 
 class _Parser:
@@ -135,6 +156,43 @@ class _Parser:
 
     # ---- statement -------------------------------------------------------
 
+    def statement(self):
+        """select [UNION [ALL] select]* — trailing ORDER/LIMIT/OFFSET
+        written after the last branch belong to the union."""
+        out = self.statement_in_parens()
+        if self.peek()[0] != "eof":
+            k, v = self.peek()
+            raise SqlError(f"unexpected {v!r} after statement")
+        return out
+
+    def statement_in_parens(self):
+        """Like statement() but stops at the enclosing context's
+        terminator (')' or eof) instead of requiring eof."""
+        parts = [self.select()]
+        all_flags = []
+        while self.at_kw("union"):
+            self.take()
+            is_all = False
+            if self.at_kw("all"):
+                self.take()
+                is_all = True
+            all_flags.append(is_all)
+            parts.append(self.select())
+        if len(parts) == 1:
+            return parts[0]
+        if len(set(all_flags)) > 1:
+            raise SqlError("mixed UNION and UNION ALL are not supported")
+        last = parts[-1]
+        u = UnionStmt(parts, all=all_flags[0], order_by=last.order_by,
+                      limit=last.limit, offset=last.offset)
+        last.order_by, last.limit, last.offset = [], None, 0
+        for p in parts[:-1]:
+            if p.order_by or p.limit is not None or p.offset:
+                raise SqlError(
+                    "ORDER BY / LIMIT inside a UNION branch is not "
+                    "supported (write it after the last branch)")
+        return u
+
     def select(self) -> SelectStmt:
         self.take_kw("select")
         stmt = SelectStmt(projections=[])
@@ -159,7 +217,17 @@ class _Parser:
                 continue
             break
         self.take_kw("from")
-        stmt.table = self.take("name")
+        if self.peek() == ("op", "("):
+            # derived table: FROM (SELECT ...) [AS] alias
+            self.take()
+            stmt.derived = self.statement_in_parens()
+            self.take("op", ")")
+            if self.at_kw("as"):
+                self.take()
+            stmt.table = self.take("name") if self.peek()[0] == "name" \
+                else "__derived"
+        else:
+            stmt.table = self.take("name")
         while True:
             if self.peek() == ("op", ","):
                 self.take()
@@ -214,9 +282,8 @@ class _Parser:
         if self.at_kw("offset"):
             self.take()
             stmt.offset = int(self.take("num"))
-        if self.peek()[0] != "eof":
-            k, v = self.peek()
-            raise SqlError(f"unexpected {v!r} after statement")
+        # end-of-input is checked by statement(): a select may also end
+        # at ')' (subquery/derived table) or UNION
         return stmt
 
     # ---- expressions -----------------------------------------------------
@@ -260,6 +327,10 @@ class _Parser:
         if self.at_kw("in"):
             self.take()
             self.take("op", "(")
+            if self.at_kw("select"):
+                sub = self.statement_in_parens()
+                self.take("op", ")")
+                return FuncCall("in_subquery", (e, Subquery(sub)))
             vals = [self.add()]
             while self.peek() == ("op", ","):
                 self.take()
@@ -290,6 +361,10 @@ class _Parser:
         if self.at_kw("in"):
             self.take()
             self.take("op", "(")
+            if self.at_kw("select"):
+                sub = self.statement_in_parens()
+                self.take("op", ")")
+                return FuncCall("in_subquery", (e, Subquery(sub)))
             vals = [self.add()]
             while self.peek() == ("op", ","):
                 self.take()
@@ -377,6 +452,10 @@ class _Parser:
             return Col(v)
         if (k, v) == ("op", "("):
             self.take()
+            if self.at_kw("select"):  # scalar subquery
+                sub = self.statement_in_parens()
+                self.take("op", ")")
+                return Subquery(sub)
             e = self.expr()
             self.take("op", ")")
             return e
@@ -409,6 +488,7 @@ class _Parser:
         return e
 
 
-def parse_sql(sql: str) -> SelectStmt:
+def parse_sql(sql: str):
+    """Parse a statement: SelectStmt, or UnionStmt for UNION [ALL]."""
     p = _Parser(_tokenize(sql))
-    return p.select()
+    return p.statement()
