@@ -188,3 +188,81 @@ def test_ssb_nation_region_chain_variant():
            .rename(columns={"s_region": "sr_name"})
            .sort_values("sr_name").reset_index(drop=True))
     pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+# --- filter-constrained dimension domains (round 3) ---------------------
+
+def _restrict_fixture():
+    from tpu_olap.bench.parity import check_query  # noqa: F401
+    rng = np.random.default_rng(7)
+    n = 5000
+    cities = [f"c{i}" for i in range(12)]
+    zone_of = {c: ("west" if i < 4 else "east" if i < 8 else "mid")
+               for i, c in enumerate(cities)}
+    city = rng.choice(cities, n)
+    df = pd.DataFrame({
+        "ts": pd.to_datetime("2022-01-01")
+        + pd.to_timedelta(rng.integers(0, 86400 * 30, n), unit="s"),
+        "city": city,
+        "zone": np.array([zone_of[c] for c in city], object),
+        "v": rng.integers(0, 100, n).astype(np.int64),
+    })
+    eng = Engine()
+    eng.register_table("f", df, time_column="ts", star_schema=StarSchema(
+        fact="f", dimensions=(),
+        functional_dependencies=(FunctionalDependency("city", "zone"),)))
+    return eng
+
+
+def test_direct_filter_restricts_dim_domain():
+    """A literal filter on the grouped dim itself shrinks its dense id
+    space to |set|+1 (the Q3.3/Q3.4 shape) with identical results."""
+    from tpu_olap.bench.parity import check_query
+    from tpu_olap.executor.lowering import lower
+    eng = _restrict_fixture()
+    sql = ("SELECT city, sum(v) AS s FROM f "
+           "WHERE city IN ('c1', 'c3') GROUP BY city ORDER BY city")
+    plan = eng.planner.plan(sql)
+    assert plan.rewritten, plan.fallback_reason
+    phys = lower(plan.query, plan.entry.segments, eng.config)
+    assert phys.total_groups == 3  # null slot + 2 allowed values
+    check_query(eng, sql)
+
+
+def test_fd_filter_restricts_determinant_domain():
+    """A filter on the FD *dependent* (zone) shrinks the grouped
+    *determinant* (city) to the codes observed with allowed dependents,
+    verified against the data."""
+    from tpu_olap.bench.parity import check_query
+    from tpu_olap.executor.lowering import lower
+    eng = _restrict_fixture()
+    sql = ("SELECT city, sum(v) AS s FROM f "
+           "WHERE zone = 'west' GROUP BY city ORDER BY city")
+    plan = eng.planner.plan(sql)
+    assert plan.rewritten, plan.fallback_reason
+    phys = lower(plan.query, plan.entry.segments, eng.config)
+    assert phys.total_groups == 5  # null slot + the 4 'west' cities
+    check_query(eng, sql)
+
+
+def test_fd_violation_disables_restriction():
+    """Data violating the declared FD must disable the remap (map is
+    None) — correctness never rests on the declaration."""
+    from tpu_olap.bench.parity import check_query
+    n = 1000
+    rng = np.random.default_rng(3)
+    df = pd.DataFrame({
+        "ts": pd.to_datetime("2022-01-01")
+        + pd.to_timedelta(np.arange(n), unit="min"),
+        "city": rng.choice(["a", "b", "c"], n),
+        "zone": rng.choice(["x", "y"], n),  # NOT functionally dependent
+        "v": np.ones(n, np.int64),
+    })
+    eng = Engine()
+    eng.register_table("f", df, time_column="ts", star_schema=StarSchema(
+        fact="f", dimensions=(),
+        functional_dependencies=(FunctionalDependency("city", "zone"),)))
+    assert eng.catalog.get("f").segments.fd_code_map("city", "zone") is None
+    sql = ("SELECT city, sum(v) AS s FROM f WHERE zone = 'x' "
+           "GROUP BY city ORDER BY city")
+    check_query(eng, sql)

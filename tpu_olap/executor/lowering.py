@@ -153,6 +153,105 @@ def _collect_columns(table, query, dim_plans, agg_plans, vexprs,
     return tuple(sorted(phys)), null_cols
 
 
+def _filter_value_sets(filter_spec) -> dict:
+    """Literal restrictions implied by top-level AND conjuncts:
+    {column: allowed value set}. Plain selector / IN / OR-of-selectors
+    only (no extraction fns) — the shapes whose passing rows provably
+    carry one of the listed values in that column."""
+    from tpu_olap.ir import filters as F
+    conjs = list(filter_spec.fields) \
+        if isinstance(filter_spec, F.AndFilter) else [filter_spec]
+    out: dict = {}
+    for c in conjs:
+        col = vs = None
+        if isinstance(c, F.SelectorFilter) and c.extraction_fn is None \
+                and c.value is not None:
+            col, vs = c.dimension, {c.value}
+        elif isinstance(c, F.InFilter):
+            col = c.dimension
+            vs = {v for v in c.values if v is not None}
+        elif isinstance(c, F.OrFilter):
+            cols, vals, ok = set(), set(), True
+            for f in c.fields:
+                if isinstance(f, F.SelectorFilter) \
+                        and f.extraction_fn is None \
+                        and f.value is not None:
+                    cols.add(f.dimension)
+                    vals.add(f.value)
+                else:
+                    ok = False
+                    break
+            if ok and len(cols) == 1:
+                col, vs = next(iter(cols)), vals
+        if col is not None:
+            out[col] = vs if col not in out else (out[col] & vs)
+    return out
+
+
+def _restrict_dims(dim_plans, filter_spec, table, pool):
+    """Shrink grouped string dims whose domain a filter restricts to a
+    literal set: the dense id space drops from |dictionary| to |set|+1
+    via a code remap (rows outside the set are masked by the same filter
+    anyway, so they may map to the null slot). Two restriction sources:
+
+    - direct: the filter names the grouped column itself (Q3.3/Q3.4's
+      city IN (...) — 113k-slot tables drop to single digits);
+    - FD hop: the filter names a column the grouped one determines
+      (declared star FD, SURVEY.md §3.4), e.g. s_nation='US' restricting
+      grouped s_city to the cities observed with that nation — verified
+      against the data (fd_code_map), never trusted blindly.
+    """
+    if filter_spec is None:
+        return dim_plans
+    sets = _filter_value_sets(filter_spec)
+    if not sets:
+        return dim_plans
+    from tpu_olap.executor.dimplan import DimPlan
+    fds = table.star.functional_dependencies if table.star else ()
+    out = []
+    for dp in dim_plans:
+        if dp.kind != "codes":
+            out.append(dp)
+            continue
+        d = table.dictionaries[dp.source_col]
+        allowed = None  # None = unrestricted; else set of codes (> 0)
+
+        vs = sets.get(dp.source_col)
+        if vs is not None:
+            allowed = {c for v in vs if (c := d.id_of(v)) > 0}
+        for fd in fds:
+            if fd.determinant != dp.source_col:
+                continue
+            dvs = sets.get(fd.dependent)
+            if dvs is None:
+                continue
+            m = table.fd_code_map(dp.source_col, fd.dependent)
+            if m is None:
+                continue
+            dep_dict = table.dictionaries[fd.dependent]
+            dep_codes = np.array(
+                sorted(c for v in dvs if (c := dep_dict.id_of(v)) > 0),
+                np.int64)
+            codes = set(np.nonzero(np.isin(m, dep_codes))[0].tolist())
+            codes.discard(0)
+            allowed = codes if allowed is None else (allowed & codes)
+
+        if allowed is None or len(allowed) + 1 >= dp.size:
+            out.append(dp)
+            continue
+        codes = sorted(allowed)
+        remap = np.zeros(dp.size, np.int32)
+        labels = np.empty(len(codes) + 1, object)
+        labels[0] = None
+        for i, c in enumerate(codes):
+            remap[c] = i + 1
+            labels[i + 1] = d.values[c - 1]
+        out.append(DimPlan(dp.name, len(codes) + 1, labels,
+                           dp.source_col, "remap",
+                           remap_name=pool.add(remap)))
+    return out
+
+
 def _lower_agg(query, table, config) -> PhysicalPlan:
     pool = ConstPool()
     intervals, t_min, t_max, empty = _time_range(query, table)
@@ -170,6 +269,7 @@ def _lower_agg(query, table, config) -> PhysicalPlan:
                                    numeric_dim_budget=config
                                    .numeric_dim_label_budget)
                  for s in dim_specs]
+    dim_plans = _restrict_dims(dim_plans, query.filter, table, pool)
 
     agg_plans = compile_aggregations(
         query.aggregations, table, pool, vexprs,

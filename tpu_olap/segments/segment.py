@@ -69,6 +69,43 @@ class TableSegments:
         self.dictionaries = dictionaries  # col -> Dictionary (STRING cols)
         self.segments = segments        # list[Segment], time-ordered
         self.block_rows = block_rows
+        # declared star schema (set at registration when provided):
+        # lowering consults its functional dependencies for data-derived
+        # dimension-domain restriction (filter on a dependent column
+        # shrinking a grouped determinant's dense id space)
+        self.star = None
+        self._fd_code_maps: dict = {}
+
+    def fd_code_map(self, det: str, dep: str):
+        """[det_codes+?] -> dep code map derived from the data (0 where
+        only-null dep observed), or None if the data violates the
+        declared FD (then no restriction is applied — correctness never
+        rests on a declaration). Cached; verified with a full pass."""
+        key = (det, dep)
+        if key in self._fd_code_maps:
+            return self._fd_code_maps[key]
+        d = self.dictionaries.get(det)
+        if d is None or dep not in self.dictionaries:
+            self._fd_code_maps[key] = None
+            return None
+        m = np.zeros(d.size + 1, np.int64)
+        ok = True
+        for s in self.segments:
+            nv = s.meta.n_valid
+            a = s.columns[det][:nv].astype(np.int64)
+            b = s.columns[dep][:nv].astype(np.int64)
+            keep = b > 0
+            m[a[keep]] = b[keep]
+        for s in self.segments:
+            nv = s.meta.n_valid
+            a = s.columns[det][:nv].astype(np.int64)
+            b = s.columns[dep][:nv].astype(np.int64)
+            keep = b > 0
+            if (m[a[keep]] != b[keep]).any():
+                ok = False
+                break
+        self._fd_code_maps[key] = m if ok else None
+        return self._fd_code_maps[key]
 
     # ---- metadata (feeds SegmentMetadata queries + cost model) -----------
 
