@@ -237,3 +237,56 @@ def test_parity_device_vs_fallback(idx):
                                rtol=1e-9, equal_nan=True), (sql, col)
         else:
             assert (av == bv).all(), (sql, col, av[:5], bv[:5])
+
+
+# --- ExprUtil simplification (SURVEY.md §3.2; round 3) -------------------
+
+def test_simplify_constant_folding():
+    from tpu_olap.ir.expr import BinOp, Col, FuncCall, Lit
+    from tpu_olap.planner.exprutil import simplify
+    assert simplify(BinOp("+", Lit(2), Lit(3))) == Lit(5)
+    assert simplify(BinOp("*", Lit(4), Lit(2.5))) == Lit(10.0)
+    assert simplify(BinOp("<", Lit(1), Lit(2))) == Lit(True)
+    assert simplify(BinOp("+", Col("x"), Lit(0))) == Col("x")
+    assert simplify(BinOp("*", Lit(1), Col("x"))) == Col("x")
+    # x*0 must NOT fold (NULL*0 is NULL)
+    z = simplify(BinOp("*", Col("x"), Lit(0)))
+    assert isinstance(z, BinOp)
+    # NULL arithmetic propagates
+    assert simplify(BinOp("+", Lit(None), Lit(3))) == Lit(None)
+    # NOT NOT x -> x; casts of literals fold
+    assert simplify(FuncCall("not", (FuncCall("not", (Col("b"),)),))) \
+        == Col("b")
+    assert simplify(FuncCall("cast_long", (Lit(3.9),))) == Lit(3)
+    assert simplify(FuncCall("cast_double", (Lit("1.5"),))) == Lit(1.5)
+    # boolean identities prune branches
+    t = BinOp("&&", BinOp(">", Lit(2), Lit(1)), Col("p"))
+    assert simplify(t) == Col("p")
+    f = BinOp("||", Col("p"), BinOp(">", Lit(1), Lit(2)))
+    assert simplify(f) == Col("p")
+
+
+def test_simplified_where_enables_rewrite():
+    """A tautological conjunct (1 < 2) would previously force fallback
+    as an unsupported literal predicate; simplification prunes it."""
+    plan = ENG.planner.plan(
+        "SELECT p_brand, sum(lo_revenue) AS s FROM lineorder "
+        "WHERE 1 < 2 AND lo_quantity > 0 GROUP BY p_brand")
+    assert plan.rewritten, plan.fallback_reason
+
+
+def test_simplify_review_regressions():
+    from tpu_olap.ir.expr import BinOp, Col, Lit
+    from tpu_olap.planner.exprutil import simplify
+    # non-numeric '/' literals must not crash planning
+    assert isinstance(simplify(BinOp("/", Lit("a"), Lit(2))), BinOp)
+    # float/bool identity elements must NOT fold (dtype coercion)
+    assert isinstance(simplify(BinOp("+", Col("q"), Lit(0.0))), BinOp)
+    assert isinstance(simplify(BinOp("*", Col("q"), Lit(1.0))), BinOp)
+    assert isinstance(simplify(BinOp("*", Col("q"), Lit(True))), BinOp)
+    # standalone tautological WHERE is dropped -> still rewrites
+    plan = ENG.planner.plan(
+        "SELECT p_brand, sum(lo_revenue) AS s FROM lineorder "
+        "WHERE 1 < 2 GROUP BY p_brand")
+    assert plan.rewritten, plan.fallback_reason
+    assert plan.stmt.where is None

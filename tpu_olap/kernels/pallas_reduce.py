@@ -39,6 +39,15 @@ __time *inside* the kernel (a filter or aggregate on raw time) is
 ineligible. Group spaces past pallas_k_per_block tile over a second grid
 axis (K-blocks × row-blocks), so K is bounded by pallas_group_cap, not by
 one onehot tile.
+
+Float sums stay on the XLA scatter path BY DESIGN: doubleSum's contract
+is f64 accumulation (exact parity with the fallback), and no bf16-plane
+decomposition keeps f32 dot-products exact once the accumulation inside
+the MXU rounds — the half-plane trick works for ints only because plane
+values are small integers whose partial sums stay below 2^24. With
+filter-constrained dim domains every SSB query's sums are integer and
+Pallas-eligible, so the float tier has no benchmark pressure; revisit
+only with a tolerance-based parity contract.
 """
 
 from __future__ import annotations

@@ -35,6 +35,145 @@ def expr_key(e) -> str:
         if hasattr(e, "to_json") else repr(e)
 
 
+_FOLD_ARITH = {
+    "+": lambda a, b: a + b, "-": lambda a, b: a - b,
+    "*": lambda a, b: a * b,
+    "%": lambda a, b: a % b if b else None,
+}
+_FOLD_CMP = {
+    "==": lambda a, b: a == b, "!=": lambda a, b: a != b,
+    "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b, ">=": lambda a, b: a >= b,
+}
+_CAST_FOLD = {
+    "cast_long": lambda v: int(v),
+    "cast_double": lambda v: float(v),
+    "cast_string": lambda v: str(v),
+}
+
+
+def simplify(e):
+    """Expression normalization — the ExprUtil analog (SURVEY.md §3.2):
+    constant folding (arithmetic, comparisons, casts of literals),
+    double-negation elimination, boolean identity pruning (x AND true,
+    x OR false), and null-safe arithmetic identities (x+0, x*1, x/1 —
+    all preserve NULL operands, unlike x*0 which must NOT fold to 0).
+    Applied to the parsed statement before planning, so the rewriter and
+    the fallback interpreter both see the same normalized tree."""
+    if e is None or isinstance(e, (Col, Lit)):
+        return e
+    if isinstance(e, BinOp):
+        left = simplify(e.left)
+        right = simplify(e.right)
+        lv = left.value if isinstance(left, Lit) else _MISS
+        rv = right.value if isinstance(right, Lit) else _MISS
+        if e.op in _FOLD_ARITH and lv is not _MISS and rv is not _MISS:
+            if lv is None or rv is None:
+                return Lit(None)
+            try:
+                folded = _FOLD_ARITH[e.op](lv, rv)
+            except Exception:
+                folded = _MISS
+            if folded is not _MISS and folded is not None:
+                return Lit(folded)
+        if e.op == "/" and lv is not _MISS and rv is not _MISS:
+            if lv is None or rv is None:
+                return Lit(None)
+            if rv:
+                try:
+                    return Lit(lv / rv)
+                except Exception:
+                    pass  # non-numeric literals: leave for runtime
+        if e.op in _FOLD_CMP and lv is not _MISS and rv is not _MISS \
+                and lv is not None and rv is not None \
+                and type(lv) is type(rv):
+            return Lit(bool(_FOLD_CMP[e.op](lv, rv)))
+        if e.op == "&&":
+            if lv is True:
+                return right
+            if rv is True:
+                return left
+            if lv is False or rv is False:
+                return Lit(False)
+        if e.op == "||":
+            if lv is False:
+                return right
+            if rv is False:
+                return left
+            if lv is True or rv is True:
+                return Lit(True)
+        # null-safe identities. INT identity elements only: x+0.0 / x*1.0
+        # coerce an int operand to double (and True==1 is bool), so the
+        # fold would change the result dtype
+        def int_ident(v, ident):
+            return type(v) is int and v == ident
+
+        if e.op in ("+", "-") and int_ident(rv, 0):
+            return left
+        if e.op == "+" and int_ident(lv, 0):
+            return right
+        if e.op in ("*", "/") and int_ident(rv, 1):
+            return left
+        if e.op == "*" and int_ident(lv, 1):
+            return right
+        return BinOp(e.op, left, right)
+    if isinstance(e, FuncCall):
+        args = tuple(simplify(a) for a in e.args)
+        if e.name == "not":
+            a = args[0]
+            if isinstance(a, FuncCall) and a.name == "not":
+                return a.args[0]  # NOT NOT x -> x
+            if isinstance(a, Lit) and isinstance(a.value, bool):
+                return Lit(not a.value)
+        if e.name in _CAST_FOLD and isinstance(args[0], Lit):
+            v = args[0].value
+            if v is None:
+                return Lit(None)
+            try:
+                return Lit(_CAST_FOLD[e.name](v))
+            except (TypeError, ValueError):
+                pass  # unparseable literal: leave for runtime semantics
+        if e.name == "if" and isinstance(args[0], Lit) \
+                and isinstance(args[0].value, bool):
+            return args[1] if args[0].value else args[2]
+        return FuncCall(e.name, args)
+    return e
+
+
+_MISS = object()
+
+
+def map_stmt_exprs(stmt, fn):
+    """Copy a SelectStmt with `fn` applied to every expression position
+    (projections, where, having, group by, join conditions, order by) —
+    the single traversal shared by normalization passes so a future
+    expression-bearing clause is added in one place."""
+    import copy
+    out = copy.copy(stmt)
+    out.projections = [(fn(e), a) for e, a in stmt.projections]
+    out.where = fn(stmt.where) if stmt.where is not None else None
+    out.having = fn(stmt.having) if stmt.having is not None else None
+    out.group_by = [fn(g) for g in stmt.group_by]
+    out.joins = [type(j)(j.table, fn(j.on) if j.on is not None else None,
+                         j.kind) for j in stmt.joins]
+    out.order_by = [type(o)(fn(o.expr), o.descending)
+                    for o in stmt.order_by]
+    return out
+
+
+def simplify_stmt(stmt):
+    """Apply simplify() across a parsed SelectStmt; a WHERE/HAVING that
+    folds to literal TRUE is dropped entirely (a tautology left in place
+    would still read as an untranslatable literal predicate and force
+    the fallback path)."""
+    out = map_stmt_exprs(stmt, simplify)
+    if out.where == Lit(True):
+        out.where = None
+    if out.having == Lit(True):
+        out.having = None
+    return out
+
+
 def render(e) -> str:
     if isinstance(e, Col):
         return e.name.split(".")[-1]

@@ -186,24 +186,9 @@ def _resolve_subqueries(stmt: SelectStmt, catalog, config) -> SelectStmt:
             return FuncCall(e.name, tuple(walk(a) for a in e.args))
         return e
 
-    import copy
-    projections = [(walk(e), a) for e, a in stmt.projections]
-    where = walk(stmt.where)
-    having = walk(stmt.having)
-    group_by = [walk(g) for g in stmt.group_by]
-    joins = [type(j)(j.table, walk(j.on), j.kind) for j in stmt.joins]
-    order_by = [type(o)(walk(o.expr), o.descending)
-                for o in stmt.order_by]
-    if not hit:
-        return stmt
-    out = copy.copy(stmt)
-    out.projections = projections
-    out.where = where
-    out.having = having
-    out.group_by = group_by
-    out.joins = joins
-    out.order_by = order_by
-    return out
+    from tpu_olap.planner.exprutil import map_stmt_exprs
+    out = map_stmt_exprs(stmt, walk)
+    return out if hit else stmt
 
 
 def _join_and_filter(stmt, df, catalog, time_col):

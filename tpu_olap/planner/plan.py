@@ -125,7 +125,12 @@ class DruidPlanner:
         # shapes outside the rewrite rules run on the fallback path (the
         # reference delegated them to full Spark SQL, SURVEY.md §3.1) —
         # declined here, never an error
+        from tpu_olap.planner.exprutil import simplify_stmt
         from tpu_olap.planner.sqlparse import UnionStmt
+        if not isinstance(stmt, UnionStmt):
+            # normalize expressions once so the rewriter and the fallback
+            # interpreter see the same tree (ExprUtil, SURVEY.md §3.2)
+            stmt = simplify_stmt(stmt)
         if isinstance(stmt, UnionStmt):
             entry = self.catalog.maybe(stmt.table)
             return PlanResult(
