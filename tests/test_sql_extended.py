@@ -197,3 +197,116 @@ def test_in_subquery_packs_values():
                 walk(a)
     walk(resolved.where)
     assert "in_list_packed" in calls
+
+
+# --- window functions (fallback-only; round 3) ---------------------------
+
+def test_row_number_over_partition():
+    eng, df = _engine()
+    got = eng.sql("SELECT g, v, row_number() OVER "
+                  "(PARTITION BY g ORDER BY v DESC, ts) AS rn FROM t")
+    assert "window function" in eng.last_plan.fallback_reason
+    # each partition's rn is a permutation of 1..n
+    for gname, sub in got.groupby("g"):
+        assert sorted(sub["rn"]) == list(range(1, len(sub) + 1))
+    # and the max-v row in each partition has rn == 1
+    for gname, sub in got.groupby("g"):
+        assert sub.loc[sub["v"].idxmax(), "rn"] == 1
+
+
+def test_rank_and_dense_rank():
+    eng, df = _engine()
+    got = eng.sql("SELECT g, v, rank() OVER (PARTITION BY g ORDER BY v) "
+                  "AS r, dense_rank() OVER (PARTITION BY g ORDER BY v) "
+                  "AS dr FROM t")
+    for gname, sub in got.groupby("g"):
+        vals = df[df.g == gname].v
+        expect_r = vals.rank(method="min").astype(int)
+        expect_dr = vals.rank(method="dense").astype(int)
+        sub = sub.sort_values("v").reset_index(drop=True)
+        assert list(sub["r"]) == sorted(expect_r)
+        assert list(sub["dr"]) == sorted(expect_dr)
+
+
+def test_window_aggregate_whole_partition():
+    eng, df = _engine()
+    got = eng.sql("SELECT g, v, sum(v) OVER (PARTITION BY g) AS gs, "
+                  "avg(v) OVER (PARTITION BY g) AS ga FROM t")
+    expect = df.groupby("g").v.agg(["sum", "mean"])
+    for gname, sub in got.groupby("g"):
+        assert (sub["gs"] == expect.loc[gname, "sum"]).all()
+        assert np.allclose(sub["ga"], expect.loc[gname, "mean"])
+
+
+def test_running_sum_window():
+    eng, df = _engine()
+    got = eng.sql("SELECT g, ts, v, sum(v) OVER "
+                  "(PARTITION BY g ORDER BY ts) AS run FROM t")
+    ref = df.sort_values("ts", kind="stable")
+    ref = ref.assign(run=ref.groupby("g").v.cumsum())
+    a = got.sort_values(["g", "ts"]).reset_index(drop=True)
+    b = ref[["g", "ts", "v", "run"]].sort_values(["g", "ts"]) \
+        .reset_index(drop=True)
+    pd.testing.assert_frame_equal(a, b, check_dtype=False)
+
+
+def test_window_over_derived_grouped():
+    """Window over a grouped derived table — ranking group totals."""
+    eng, df = _engine()
+    got = eng.sql(
+        "SELECT g, s, rank() OVER (ORDER BY s DESC) AS r FROM "
+        "(SELECT g, sum(v) AS s FROM t GROUP BY g) sub ORDER BY r")
+    totals = df.groupby("g").v.sum().sort_values(ascending=False)
+    assert list(got["g"]) == list(totals.index)
+    assert list(got["r"]) == [1, 2, 3, 4]
+
+
+def test_window_null_partition_and_values():
+    """NULL partition keys form their own partition; running aggregates
+    skip NULL values (carry at NULL rows, NULL while the frame is
+    empty); avg divides by the non-null count."""
+    eng = Engine()
+    df = pd.DataFrame({
+        "ts": pd.to_datetime("2023-01-01") + pd.to_timedelta(
+            np.arange(6), unit="h"),
+        "g": ["a", "a", "a", None, None, "b"],
+        "v": pd.array([1, None, 3, 5, None, None], dtype="Int64"),
+    })
+    eng.register_table("w", df, time_column="ts")
+    got = eng.sql(
+        "SELECT g, v, row_number() OVER (PARTITION BY g ORDER BY ts) "
+        "AS rn, sum(v) OVER (PARTITION BY g ORDER BY ts) AS rs, "
+        "avg(v) OVER (PARTITION BY g ORDER BY ts) AS ra FROM w")
+    assert list(got["rn"]) == [1, 2, 3, 1, 2, 1]
+    rs = list(got["rs"])
+    assert rs[0] == 1 and rs[1] == 1 and rs[2] == 4  # carry at NULL
+    assert rs[3] == 5 and rs[4] == 5
+    assert pd.isna(rs[5])  # empty frame so far -> NULL
+    ra = list(got["ra"])
+    assert ra[0] == 1.0 and ra[1] == 1.0 and ra[2] == 2.0
+
+
+def test_window_over_chunked_table_refuses_clearly(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    import pytest as _pytest
+
+    from tpu_olap.executor import EngineConfig
+    from tpu_olap.planner.fallback import FallbackError, execute_fallback
+    df = _df(2000)
+    p = str(tmp_path / "w.parquet")
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), p)
+    eng = Engine(EngineConfig(fallback_chunk_rows=100))
+    eng.register_table("t", p, time_column="ts")
+    stmt = eng.planner.plan(
+        "SELECT g, row_number() OVER (PARTITION BY g ORDER BY v) AS rn "
+        "FROM t").stmt
+    with _pytest.raises(FallbackError, match="whole partition"):
+        execute_fallback(stmt, eng.catalog, eng.config)
+
+
+def test_subquery_inside_window_spec():
+    eng, df = _engine()
+    got = eng.sql("SELECT g, v, rank() OVER "
+                  "(ORDER BY v - (SELECT min(v) FROM t)) AS r FROM t")
+    assert int(got.loc[got["v"].idxmin(), "r"]) == 1

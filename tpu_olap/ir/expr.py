@@ -108,6 +108,32 @@ class FuncCall(Expr):
 
 
 @dataclass(frozen=True)
+class WindowCall(Expr):
+    """fn(args) OVER (PARTITION BY ... ORDER BY ...). Fallback-only,
+    like Subquery: the planner declines statements containing one and
+    the pandas interpreter evaluates it (whole-partition aggregates
+    without ORDER BY; running aggregates / rank functions with it)."""
+    name: str
+    args: tuple
+    partition_by: tuple = ()
+    order_by: tuple = ()       # ((expr, descending), ...)
+
+    def columns(self):
+        out = set()
+        for a in self.args:
+            out |= a.columns()
+        for p in self.partition_by:
+            out |= p.columns()
+        for e, _ in self.order_by:
+            out |= e.columns()
+        return out
+
+    def to_json(self):
+        # structural identity only (expr_key); never sent to a device
+        return {"type": "window", "name": self.name, "repr": repr(self)}
+
+
+@dataclass(frozen=True)
 class Subquery(Expr):
     """A nested SELECT used as a scalar or IN-list source. Never lowers
     to the device IR (no to_json on purpose): the planner treats any

@@ -14,7 +14,8 @@ import re
 import numpy as np
 import pandas as pd
 
-from tpu_olap.ir.expr import BinOp, Col, FuncCall, Lit, Subquery
+from tpu_olap.ir.expr import (BinOp, Col, FuncCall, Lit, Subquery,
+                              WindowCall)
 from tpu_olap.planner.exprutil import (contains_agg as _contains_agg,
                                        expr_key as _k, render as _auto_name,
                                        split_and as _split_and)
@@ -182,6 +183,11 @@ def _resolve_subqueries(stmt: SelectStmt, catalog, config) -> SelectStmt:
                              Lit(tuple(sorted(mapping.items())))))
         if isinstance(e, BinOp):
             return BinOp(e.op, walk(e.left), walk(e.right))
+        if isinstance(e, WindowCall):
+            return WindowCall(
+                e.name, tuple(walk(a) for a in e.args),
+                tuple(walk(p) for p in e.partition_by),
+                tuple((walk(oe), d) for oe, d in e.order_by))
         if isinstance(e, FuncCall):
             return FuncCall(e.name, tuple(walk(a) for a in e.args))
         return e
@@ -399,6 +405,25 @@ def _execute_chunked(stmt: SelectStmt, entry, catalog, config):
     group_exprs = list(stmt.group_by)
     if stmt.distinct and not has_agg and not group_exprs:
         group_exprs = list(exprs)
+
+    def has_window(e):
+        if isinstance(e, WindowCall):
+            return True
+        if isinstance(e, BinOp):
+            return has_window(e.left) or has_window(e.right)
+        if isinstance(e, FuncCall):
+            return any(has_window(a) for a in e.args)
+        return False
+
+    if any(has_window(x) for x in exprs) or \
+            any(has_window(o.expr) for o in stmt.order_by):
+        # per-chunk window evaluation would silently restart partitions
+        # at every chunk boundary; requiring the whole frame here would
+        # be the OOM the chunked path exists to avoid
+        raise FallbackError(
+            "window functions need the whole partition resident; over a "
+            "chunked-scale table, aggregate first in a derived table "
+            "(FROM (SELECT ... GROUP BY ...)) and window over that")
 
     if group_exprs or has_agg:
         return _chunked_aggregate(stmt, chunks, exprs, out_names,
@@ -787,6 +812,8 @@ def _eval(e, df, time_col):
             # _expr_null_mask — matching kernels.exprs.virtual_null_mask.
             out = out.fillna(False).astype(bool)
         return out
+    if isinstance(e, WindowCall):
+        return _eval_window(e, df, time_col)
     if isinstance(e, FuncCall):
         fn = e.name
         if fn in _TIME_FUNCS:
@@ -891,6 +918,93 @@ def _eval(e, df, time_col):
             return pd.Series(f(a, b), index=getattr(a, "index", df.index))
         raise FallbackError(f"unknown function {fn!r}")
     raise FallbackError(f"cannot evaluate {e!r}")
+
+
+_RANK_FNS = {"row_number", "rank", "dense_rank"}
+_WINDOW_AGGS = {"sum", "min", "max", "count", "avg"}
+
+
+def _eval_window(e: WindowCall, df, time_col) -> pd.Series:
+    """fn() OVER (PARTITION BY ... ORDER BY ...) -> Series aligned with
+    df. Rank functions need ORDER BY; aggregates compute over the whole
+    partition without it and as running (cumulative) aggregates with it
+    (the standard's default RANGE UNBOUNDED PRECEDING frame, approximated
+    row-wise)."""
+    if e.name not in _RANK_FNS | _WINDOW_AGGS:
+        raise FallbackError(f"unsupported window function {e.name!r}")
+
+    # NULL partition keys form their own partition: string keys fill
+    # with the sentinel, non-string keys rely on dropna=False groupbys
+    keys = [_fill_strings(_eval(p, df, time_col)) for p in e.partition_by]
+    grouped_keys = keys if keys else [pd.Series(0, index=df.index)]
+
+    def by(series):
+        return series.groupby(grouped_keys, dropna=False)
+
+    order_cols = []
+    ascending = []
+    work = pd.DataFrame(index=df.index)
+    for i, (oe, desc) in enumerate(e.order_by):
+        work[f"__o{i}"] = _eval(oe, df, time_col)
+        order_cols.append(f"__o{i}")
+        ascending.append(not desc)
+
+    if e.name in _RANK_FNS:
+        if not e.order_by:
+            raise FallbackError(f"{e.name}() requires ORDER BY")
+        # global sorted position handles any mix of directions; ties
+        # collapse through the tuple of ORDER BY values
+        order = work.sort_values(order_cols, ascending=ascending,
+                                 kind="stable", key=_null_low_key).index
+        pos = pd.Series(np.arange(len(df)), index=order).reindex(df.index)
+        rn = by(pos).rank(method="first")
+        if e.name == "row_number":
+            return rn.astype(np.int64)
+        tie = work[order_cols].apply(tuple, axis=1)
+        min_rn = rn.groupby(grouped_keys + [tie],
+                            dropna=False).transform("min")
+        if e.name == "rank":
+            return min_rn.astype(np.int64)
+        return by(min_rn).rank(method="dense").astype(np.int64)
+
+    v = _eval_agg_input(e.args[0], df, time_col) if e.args else \
+        pd.Series(1, index=df.index)
+    if not e.order_by:
+        g = by(v)
+        if e.name == "count":
+            out = g.transform("count") if e.args else \
+                g.transform("size")
+        elif e.name == "avg":
+            out = g.transform("sum") / g.transform("count")
+        else:
+            out = g.transform(e.name)
+        return out
+    # running aggregates in ORDER BY order, mapped back to row order.
+    # SQL frame semantics over NULL values: the frame aggregate skips
+    # NULLs, so at a NULL-value row the running value CARRIES (it is the
+    # aggregate of the prior frame), and it is NULL only while the frame
+    # has seen no non-null value yet.
+    order = work.sort_values(order_cols, ascending=ascending,
+                             kind="stable", key=_null_low_key).index
+    vs = v.reindex(order)
+    gk = [k.reindex(order) for k in grouped_keys]
+
+    def gby(s):
+        return s.groupby(gk, dropna=False)
+
+    nn_cum = gby(vs.notna().astype(np.int64)).cumsum()
+    if e.name == "count":
+        run = nn_cum if e.args else \
+            gby(pd.Series(1, index=vs.index)).cumsum()
+    elif e.name in ("sum", "avg"):
+        s_run = gby(vs.fillna(0)).cumsum()
+        run = s_run.where(nn_cum > 0)
+        if e.name == "avg":
+            run = run / nn_cum.where(nn_cum > 0)
+    else:
+        run = gby(vs).cummin() if e.name == "min" else gby(vs).cummax()
+        run = gby(run).ffill()  # carry over NULL-value rows
+    return run.reindex(df.index)
 
 
 def _expr_null_mask(e, df, time_col):

@@ -93,23 +93,37 @@ class PlanResult:
                 else self.stmt.table}
 
 
-def _stmt_has_subquery(stmt) -> bool:
-    from tpu_olap.ir.expr import Subquery
+def _outside_subset(stmt) -> str | None:
+    """'subquery' / 'window function' when the statement contains a
+    construct the rewrite rules don't cover, else None."""
+    from tpu_olap.ir.expr import Subquery, WindowCall
 
     def walk(e):
         if isinstance(e, Subquery):
-            return True
+            return "subquery"
+        if isinstance(e, WindowCall):
+            return "window function"
         if isinstance(e, BinOp):
             return walk(e.left) or walk(e.right)
         if isinstance(e, FuncCall):
-            return e.name == "in_subquery" or any(walk(a) for a in e.args)
-        return False
+            if e.name == "in_subquery":
+                return "subquery"
+            for a in e.args:
+                r = walk(a)
+                if r:
+                    return r
+        return None
 
     exprs = ([e for e, _ in stmt.projections] + stmt.group_by
              + [stmt.where, stmt.having]
              + [o.expr for o in stmt.order_by]
              + [j.on for j in stmt.joins])
-    return any(e is not None and walk(e) for e in exprs)
+    for e in exprs:
+        if e is not None:
+            r = walk(e)
+            if r:
+                return r
+    return None
 
 
 class DruidPlanner:
@@ -141,10 +155,11 @@ class DruidPlanner:
                 stmt=stmt, entry=None, sql=sql,
                 fallback_reason="derived table (FROM subquery) executes "
                                 "on the fallback path")
-        if _stmt_has_subquery(stmt):
+        outside = _outside_subset(stmt)
+        if outside is not None:
             return PlanResult(
                 stmt=stmt, entry=self.catalog.get(stmt.table), sql=sql,
-                fallback_reason="subquery executes on the fallback path")
+                fallback_reason=f"{outside} executes on the fallback path")
         entry = self.catalog.get(stmt.table)
         result = PlanResult(stmt=stmt, entry=entry, sql=sql)
         try:

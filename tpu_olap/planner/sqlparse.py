@@ -21,7 +21,7 @@ import re
 from dataclasses import dataclass, field
 
 from tpu_olap.ir.expr import (BinOp, Col, Expr, FuncCall, Lit,
-                              Subquery)
+                              Subquery, WindowCall)
 
 AGG_FUNCS = {"count", "sum", "min", "max", "avg", "count_distinct",
              "approx_count_distinct", "theta_sketch"}
@@ -263,19 +263,8 @@ class _Parser:
         if self.at_kw("order"):
             self.take()
             self.take_kw("by")
-            while True:
-                e = self.expr()
-                desc = False
-                if self.at_kw("asc"):
-                    self.take()
-                elif self.at_kw("desc"):
-                    self.take()
-                    desc = True
-                stmt.order_by.append(OrderItem(e, desc))
-                if self.peek() == ("op", ","):
-                    self.take()
-                    continue
-                break
+            stmt.order_by = [OrderItem(e, d) for e, d in
+                             self._order_items()]
         if self.at_kw("limit"):
             self.take()
             stmt.limit = int(self.take("num"))
@@ -448,6 +437,9 @@ class _Parser:
                     if fname != "count":
                         raise SqlError("DISTINCT only inside COUNT()")
                     fname = "count_distinct"
+                k2, v2 = self.peek()
+                if k2 == "name" and v2.lower() == "over":
+                    return self._window(fname, tuple(args))
                 return FuncCall(fname, tuple(args))
             return Col(v)
         if (k, v) == ("op", "("):
@@ -460,6 +452,45 @@ class _Parser:
             self.take("op", ")")
             return e
         raise SqlError(f"unexpected token {v!r}")
+
+    def _window(self, fname: str, args: tuple):
+        """fn(...) OVER ([PARTITION BY e, ...] [ORDER BY e [DESC], ...])"""
+        self.take("name")  # 'over'
+        self.take("op", "(")
+        partition: list = []
+        order: list = []
+        k, v = self.peek()
+        if k == "name" and v.lower() == "partition":
+            self.take()
+            self.take_kw("by")
+            partition.append(self.expr())
+            while self.peek() == ("op", ","):
+                self.take()
+                partition.append(self.expr())
+        if self.at_kw("order"):
+            self.take()
+            self.take_kw("by")
+            order = self._order_items()
+        self.take("op", ")")
+        return WindowCall(fname, args, tuple(partition), tuple(order))
+
+    def _order_items(self) -> list:
+        """Comma list of `expr [ASC|DESC]` -> [(expr, descending)]."""
+        out = []
+        while True:
+            e = self.expr()
+            desc = False
+            if self.at_kw("asc"):
+                self.take()
+            elif self.at_kw("desc"):
+                self.take()
+                desc = True
+            out.append((e, desc))
+            if self.peek() == ("op", ","):
+                self.take()
+                continue
+            break
+        return out
 
     def _case(self):
         """CASE [operand] WHEN c THEN v ... [ELSE d] END -> nested if()."""
