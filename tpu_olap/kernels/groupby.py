@@ -263,6 +263,10 @@ def _field_value(env, field, xp):
 
 
 def _seg_sum(v, key, k, xp):
+    if k == 1:
+        # single group (granularity=all, no dims — the BI total shape):
+        # a plain sum vectorizes where a 1-slot scatter-add would not
+        return v.sum(axis=0).reshape((1,) + v.shape[1:])
     if xp is np:
         out = np.zeros((k,) + v.shape[1:], v.dtype)
         np.add.at(out, key, v)
@@ -271,6 +275,10 @@ def _seg_sum(v, key, k, xp):
 
 
 def _seg_minmax(v, key, k, kind, xp):
+    if k == 1:
+        # single group: plain reduction, not a 1-slot scatter
+        red = v.min if kind == "min" else v.max
+        return red(axis=0).reshape((1,) + v.shape[1:])
     if xp is np:
         ident = _ident(v.dtype, kind)
         out = np.full((k,), ident, v.dtype)
