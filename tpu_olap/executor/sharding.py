@@ -257,8 +257,31 @@ def sharded_sparse_exchange_kernel(kernel, plan, mesh: Mesh,
 
 
 def shard_put(arr: np.ndarray, mesh: Mesh):
-    """Host array -> device array sharded on the leading axis."""
-    return jax.device_put(arr, NamedSharding(mesh, P(DATA_AXIS)))
+    """Host array -> device array sharded on the leading axis.
+
+    Uses make_array_from_callback, the multi-host-correct formulation:
+    each process materializes only the shards addressable on ITS devices
+    (on a single host this degenerates to a plain sharded device_put).
+    With a multi-host mesh (jax.distributed initialized and make_mesh
+    over global devices), every host feeds its local slice of the
+    segment axis — no host ever holds the whole table (SURVEY.md §3.6:
+    ICI within a slice, DCN across)."""
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx])
+
+
+def make_multihost_mesh(num_shards: int | None = None) -> Mesh:
+    """Mesh over ALL processes' devices (call after
+    jax.distributed.initialize on every host). Single-process callers
+    get the same mesh make_mesh builds; multi-host callers get a 1-D
+    segment axis spanning hosts — psum/all_to_all then ride ICI within a
+    slice and DCN across slices, with no code change in the kernels."""
+    devs = jax.devices()
+    n = num_shards or len(devs)
+    if n > len(devs):
+        raise ValueError(f"num_shards={n} exceeds {len(devs)} devices")
+    return Mesh(np.array(devs[:n]), (DATA_AXIS,))
 
 
 def replicate_put(arr, mesh: Mesh):
