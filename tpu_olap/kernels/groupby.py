@@ -173,33 +173,34 @@ def group_reduce(key, mask, env, plans, num_groups, consts):
     xp = jnp if not isinstance(mask, np.ndarray) else np
     out = {}
     key = xp.where(mask, key, 0)  # masked rows: contribute zeros to group 0
+    out["_rows"] = _seg_sum(mask.astype(np.int32), key, num_groups, xp)
 
-    # additive reductions (sum/count/_rows/_nn) all share `key`: a row
-    # excluded by an aggregator's own mask carries a ZERO value, so its
-    # routing is harmless — which lets every additive column ride ONE
-    # [N, A] segment-sum (one index-driven pass over the data) instead
-    # of A separate scatters. min/max batch the same way per kind: their
-    # excluded rows carry the identity element.
-    add_batch: list = [("_rows", mask.astype(np.int32))]
-    minmax_batch: dict = {"min": [], "max": []}
     for p in plans:
         m = mask if p.filter_fn is None else (mask & p.filter_fn(env, consts))
+        if p.filter_fn is not None:
+            m_key = xp.where(m, key, 0)
+        else:
+            m_key = key
         if p.kind == "count":
-            add_batch.append((p.name, m.astype(p.acc_dtype)))
+            out[p.name] = _seg_sum(m.astype(p.acc_dtype), m_key, num_groups,
+                                   xp)
             continue
         if p.kind in ("sum", "min", "max"):
             x = _field_value(env, p.fields[0], xp)
             nulls = env["nulls"].get(p.fields[0])
             mm = m & ~nulls if nulls is not None else m
             if p.kind == "sum":
-                add_batch.append(
-                    (p.name, xp.where(mm, x, 0).astype(p.acc_dtype)))
+                v = xp.where(mm, x, 0).astype(p.acc_dtype)
+                out[p.name] = _seg_sum(v, xp.where(mm, key, 0), num_groups, xp)
             else:
                 ident = _ident(p.acc_dtype, p.kind)
-                minmax_batch[p.kind].append(
-                    (p.name, xp.where(mm, x.astype(p.acc_dtype), ident)))
+                v = xp.where(mm, x.astype(p.acc_dtype), ident)
+                out[p.name] = _seg_minmax(v, xp.where(mm, key, 0), num_groups,
+                                          p.kind, xp)
             # per-plan non-null counts for null-correct finalize
-            add_batch.append((f"_nn_{p.name}", mm.astype(np.int32)))
+            out[f"_nn_{p.name}"] = _seg_sum(mm.astype(np.int32),
+                                            xp.where(mm, key, 0),
+                                            num_groups, xp)
             continue
         if p.kind == "hll":
             if p.by_row or len(p.fields) <= 1:
@@ -228,31 +229,7 @@ def group_reduce(key, mask, env, plans, num_groups, consts):
                                                  p.theta_k, xp)
             continue
         raise UnsupportedAggregation(p.kind)
-
-    _emit_batched(out, add_batch, key, num_groups, "add", xp)
-    for kind, items in minmax_batch.items():
-        _emit_batched(out, items, key, num_groups, kind, xp)
     return out
-
-
-def _emit_batched(out, items, key, k, op, xp):
-    """Scatter a batch of same-op columns in one [N, A] pass per dtype
-    (mixed dtypes can't stack; within a dtype the stack is free traffic
-    relative to A separate index-driven passes)."""
-    by_dtype: dict = {}
-    for name, v in items:
-        by_dtype.setdefault(np.dtype(v.dtype), []).append((name, v))
-    for dt, group in by_dtype.items():
-        if len(group) == 1:
-            name, v = group[0]
-            out[name] = _seg_sum(v, key, k, xp) if op == "add" else \
-                _seg_minmax(v, key, k, op, xp)
-            continue
-        stacked = xp.stack([v for _, v in group], axis=1)
-        res = _seg_sum(stacked, key, k, xp) if op == "add" else \
-            _seg_minmax(stacked, key, k, op, xp)
-        for i, (name, _) in enumerate(group):
-            out[name] = res[:, i]
 
 
 def merge_partials(a: dict, b: dict, plans) -> dict:
@@ -304,7 +281,7 @@ def _seg_minmax(v, key, k, kind, xp):
         return red(axis=0).reshape((1,) + v.shape[1:])
     if xp is np:
         ident = _ident(v.dtype, kind)
-        out = np.full((k,) + v.shape[1:], ident, v.dtype)
+        out = np.full((k,), ident, v.dtype)
         (np.minimum if kind == "min" else np.maximum).at(out, key, v)
         return out
     f = jax.ops.segment_min if kind == "min" else jax.ops.segment_max
