@@ -203,3 +203,28 @@ def test_unbudgeted_ledger_keeps_all():
     eng.register_table("t", df, time_column="ts", block_rows=1024)
     eng.sql("SELECT city, sum(qty) AS s FROM t GROUP BY city")
     assert eng.runner._hbm_ledger.evictions == 0
+
+
+def test_all_null_string_batch_streams(tmp_path):
+    """A parquet file whose string column is entirely null in a batch
+    reads via read_dictionary as an EMPTY dictionary — must ingest as
+    all-null codes, not crash (round-3 dictionary fast path)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from tpu_olap.segments.ingest import ingest_parquet_stream
+    n = 600
+    df = pd.DataFrame({
+        "ts": pd.to_datetime("2022-01-01")
+        + pd.to_timedelta(np.arange(n), unit="min"),
+        "s": pd.array([None] * n, dtype="string"),
+        "v": np.arange(n, dtype=np.int64),
+    })
+    p = str(tmp_path / "nulls.parquet")
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), p,
+                   row_group_size=128)
+    seg = ingest_parquet_stream("t", [p], "ts", block_rows=256)
+    assert seg.num_rows == n
+    assert seg.dictionaries["s"].cardinality == 0
+    assert all((s.columns["s"][:s.meta.n_valid] == 0).all()
+               for s in seg.segments)
