@@ -143,7 +143,17 @@ def main():
     # ledger's accounting (and eviction under pressure) is always live.
     hbm_budget = int(os.environ.get(
         "BENCH_HBM_BUDGET_BYTES", 8 * 2**30))
-    eng = Engine(EngineConfig(hbm_budget_bytes=hbm_budget))
+    # SSB_USE_PALLAS=never|force|auto: lets the probe bank a
+    # Pallas-vs-XLA-scatter comparison on the same data when the TPU
+    # tunnel opens (auto = Pallas on TPU where eligible). Validated
+    # HERE: failing after a full ingest (or inside a scarce tunnel
+    # up-window) on a typo would waste the run.
+    use_pallas = os.environ.get("SSB_USE_PALLAS", "auto")
+    if use_pallas not in ("auto", "force", "never"):
+        raise SystemExit(
+            f"SSB_USE_PALLAS={use_pallas!r}: must be auto|force|never")
+    eng = Engine(EngineConfig(hbm_budget_bytes=hbm_budget,
+                              use_pallas=use_pallas))
     t0 = time.perf_counter()
     register_ssb_parquet(eng, paths, dims)
     ingest_s = time.perf_counter() - t0
@@ -181,6 +191,7 @@ def main():
         "vs_baseline": round(TARGET_MS / worst, 2),
         "detail": {
             "rows": rows, "backend": backend,
+            "use_pallas": use_pallas,
             "per_query_p50_ms": detail,
             "ram_cap_gb": cap_gb,
             "generate_s": round(gen_s, 1),

@@ -35,13 +35,16 @@ def log(rec):
         f.write(json.dumps(rec) + "\n")
 
 
-def attempt_bench():
+def attempt_bench(use_pallas: str | None = None):
     """Run bench.py on the default backend. Returns (status, rec|None):
     status in {"tpu", "cpu", "timeout", "error"}."""
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
-    env["BENCH_SKIP_PROBE"] = "1"
+    env.pop("SSB_USE_PALLAS", None)  # a stale export must not leak into
+    env["BENCH_SKIP_PROBE"] = "1"    # the banked headline (auto) run
     env.setdefault("SSB_ROWS", "6000000")
+    if use_pallas is not None:
+        env["SSB_USE_PALLAS"] = use_pallas
     try:
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py")],
@@ -84,6 +87,21 @@ def main():
             banked = True
             log({"event": "banked TPU bench",
                  "value": rec.get("value")})
+            # bank the XLA-scatter leg of the Pallas comparison while
+            # the tunnel is up (the banked auto run IS the Pallas leg:
+            # on TPU, auto uses the kernel for every eligible plan, and
+            # all 13 SSB queries are eligible). Skipped once banked —
+            # tunnel up-time is too scarce to re-measure hourly.
+            cmp_path = os.path.join(REPO, "BENCH_TPU_PALLAS_never.json")
+            if not os.path.exists(cmp_path):
+                s2, r2 = attempt_bench(use_pallas="never")
+                log({"event": "pallas-never bench", "status": s2,
+                     "value": (r2 or {}).get("value"),
+                     **({"error": r2} if s2 in ("error", "timeout")
+                        and r2 else {})})
+                if s2 == "tpu":
+                    with open(cmp_path, "w") as f:
+                        json.dump(r2, f, indent=1)
         time.sleep(PERIOD if not banked else max(PERIOD, 3600))
     log({"event": "probe loop done", "attempts": n, "banked": banked})
 
