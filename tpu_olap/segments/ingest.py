@@ -220,17 +220,26 @@ class StreamIngestor:
         cols[TIME_COLUMN] = _convert_time(
             table.column(tc) if tc is not None else None, n)
         schema = {TIME_COLUMN: ColumnType.LONG}
+        import pyarrow.compute as pc
         for fld in table.schema:
             c = fld.name
             if c == tc or c == TIME_COLUMN:
                 continue
-            if pa.types.is_dictionary(fld.type) and (
-                    pa.types.is_string(fld.type.value_type)
-                    or pa.types.is_large_string(fld.type.value_type)):
+            ftype = fld.type
+            arr = None
+            if pa.types.is_string(ftype) or pa.types.is_large_string(ftype):
+                # flat strings (in-memory ingest): hash-encode in C++
+                # so they ride the same dictionary fast path as parquet
+                arr = pc.dictionary_encode(
+                    table.column(c).combine_chunks())
+                ftype = arr.type
+            if pa.types.is_dictionary(ftype) and (
+                    pa.types.is_string(ftype.value_type)
+                    or pa.types.is_large_string(ftype.value_type)):
                 # arrow-dictionary fast path: remap small dictionaries,
                 # gather row indices (see DictBuilder.encode_indices)
-                import pyarrow.compute as pc
-                arr = table.column(c).combine_chunks()
+                if arr is None:
+                    arr = table.column(c).combine_chunks()
                 null = np.asarray(arr.is_null())
                 idx = pc.fill_null(arr.indices, 0).to_numpy(
                     zero_copy_only=False)
