@@ -44,10 +44,14 @@ class DimPlan:
             v = env["cols"][self.source_col]
             i = (v - consts[self.offset_name]).astype(xp.int32)
             # out-of-range/null -> slot 0 (null); executor masks via labels
-            i = xp.where((i >= 1) & (i < self.size), i, 0)
+            # np.int32 zero, not a Python 0: under x64 a weak scalar enters
+            # jnp.where as i64 and Mosaic's scalar i64->i32 lowering
+            # recurses when this runs inside the Pallas kernel
+            z = np.int32(0)
+            i = xp.where((i >= 1) & (i < self.size), i, z)
             nm = env["nulls"].get(self.source_col)
             if nm is not None:
-                i = xp.where(nm, 0, i)
+                i = xp.where(nm, z, i)
             return i
         if self.kind == "remap":
             codes = env["cols"][self.source_col]

@@ -26,12 +26,15 @@ _ARITH = {
 }
 
 
-def eval_expr(expr: Expr, env: dict, xp):
+def eval_expr(expr: Expr, env: dict, xp, narrow_ints: bool = False):
     """Evaluate an expression AST.
 
     env maps column name -> array (numeric values; dict codes are NOT
     valid inputs — the planner resolves string columns before lowering).
-    xp is the array module (numpy or jax.numpy).
+    xp is the array module (numpy or jax.numpy). narrow_ints=True is the
+    Pallas-kernel mode: every node was proven to fit int32 at eligibility
+    time, so int literals may be coerced to int32 (required — Mosaic
+    cannot lower the weak-i64 scalars x64 would otherwise produce).
     """
     if isinstance(expr, Lit):
         return expr.value
@@ -40,15 +43,15 @@ def eval_expr(expr: Expr, env: dict, xp):
             raise KeyError(f"unknown column {expr.name!r} in expression")
         return env[expr.name]
     if isinstance(expr, BinOp):
-        left = eval_expr(expr.left, env, xp)
-        right = eval_expr(expr.right, env, xp)
+        left = eval_expr(expr.left, env, xp, narrow_ints)
+        right = eval_expr(expr.right, env, xp, narrow_ints)
         if expr.op == "/":
             # SQL-style: integer operands still divide as floats
             left = _as_float(left, xp)
         return _ARITH[expr.op](left, right)
     if isinstance(expr, FuncCall):
-        args = [eval_expr(a, env, xp) for a in expr.args]
-        return _call(expr.name, args, xp)
+        args = [eval_expr(a, env, xp, narrow_ints) for a in expr.args]
+        return _call(expr.name, args, xp, narrow_ints)
     raise TypeError(f"not an expression: {expr!r}")
 
 
@@ -93,7 +96,7 @@ def materialize_virtuals(vexprs: dict, cols: dict, nulls: dict, xp,
     every intermediate to int32 at eligibility time)."""
     for name, ex in vexprs.items():
         env = widen_int_env(ex, cols, xp) if wide_ints else cols
-        cols[name] = eval_expr(ex, env, xp)
+        cols[name] = eval_expr(ex, env, xp, narrow_ints=not wide_ints)
         nm = virtual_null_mask(ex, nulls, xp)
         if nm is not None:
             nulls[name] = nm
@@ -106,7 +109,7 @@ def _as_float(v, xp):
     return v
 
 
-def _call(name, args, xp):
+def _call(name, args, xp, narrow_ints: bool = False):
     if name == "abs":
         return xp.abs(args[0])
     if name == "floor":
@@ -122,7 +125,20 @@ def _call(name, args, xp):
     if name == "pow":
         return xp.power(args[0], args[1])
     if name == "if":
-        return xp.where(args[0], args[1], args[2])
+        a1, a2 = args[1], args[2]
+        if narrow_ints:
+            # Pallas-kernel mode only: Python-int branches would enter
+            # xp.where as weak i64 scalars under x64, and Mosaic cannot
+            # lower scalar i64->i32 (infinite recursion). Eligibility
+            # bounded every node to int32, so the coercion is exact. The
+            # wide (XLA/numpy) path keeps i64 literals — downstream
+            # arithmetic may legitimately exceed int32 there.
+            import numpy as _np
+            if type(a1) is int and -2**31 <= a1 < 2**31:
+                a1 = _np.int32(a1)
+            if type(a2) is int and -2**31 <= a2 < 2**31:
+                a2 = _np.int32(a2)
+        return xp.where(args[0], a1, a2)
     if name in ("min", "least"):
         return xp.minimum(args[0], args[1])
     if name in ("max", "greatest"):

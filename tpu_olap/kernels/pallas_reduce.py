@@ -102,12 +102,20 @@ class _Ineligible(Exception):
     pass
 
 
-def kernel_columns(plan) -> tuple:
-    """Physical columns read INSIDE the kernel: filter + agg + dim inputs
-    expanded through virtual columns. Excludes __time uses that the host
-    wrapper precomputes (bucket ids, interval mask) — if __time appears
-    here, the query reads raw time in-kernel and is ineligible (the kernel
-    interior is int32-only)."""
+# Dimension kinds whose ids are computed INSIDE the kernel (pure int32
+# arithmetic). remap/timeformat ids need a dynamic gather, which Mosaic
+# does not lower for 1-D operands ("Only 2D gather is supported", v5e) —
+# the host wrapper precomputes those in fused XLA and streams the int32
+# ids in like granularity buckets.
+IN_KERNEL_DIM_KINDS = ("codes", "numeric")
+
+
+def _kernel_refs(plan) -> set:
+    """Column NAMES (physical or virtual) referenced inside the kernel:
+    filter + agg + in-kernel dim inputs. Gather-needing dims
+    (remap/timeformat) are precomputed on the host side; their source
+    columns (possibly __time) never enter the kernel unless a filter/agg
+    also reads them."""
     q = plan.query
     cols: set = set()
     if q.filter is not None:
@@ -115,7 +123,7 @@ def kernel_columns(plan) -> tuple:
     for p in plan.agg_plans:
         cols |= set(p.fields)
     for dp in plan.dim_plans:
-        if dp.source_col:
+        if dp.source_col and dp.kind in IN_KERNEL_DIM_KINDS:
             cols.add(dp.source_col)
 
     def agg_filter_cols(spec):
@@ -125,8 +133,23 @@ def kernel_columns(plan) -> tuple:
 
     for a in q.aggregations:
         cols |= agg_filter_cols(a)
+    return cols
+
+
+def kernel_virtuals(plan) -> dict:
+    """The subset of plan.virtual_exprs the kernel must materialize."""
+    refs = _kernel_refs(plan)
+    return {c: e for c, e in plan.virtual_exprs.items() if c in refs}
+
+
+def kernel_columns(plan) -> tuple:
+    """Physical columns read INSIDE the kernel: _kernel_refs expanded
+    through virtual columns. If __time appears here, the query reads raw
+    time in-kernel and is ineligible (the kernel interior is int32-only;
+    host-precomputed bucket ids / interval masks / dim ids are not
+    in-kernel reads)."""
     phys: set = set()
-    for c in cols:
+    for c in _kernel_refs(plan):
         phys |= (plan.virtual_exprs[c].columns()
                  if c in plan.virtual_exprs else {c})
     return tuple(sorted(phys))
@@ -159,14 +182,15 @@ def traced_const_names(plan, table, filter_fn) -> list:
     kcols = kernel_columns(plan)
     cols = {c: np.zeros(n, np.int64) for c in kcols}
     nulls = {c: np.zeros(n, bool) for c in plan.null_cols if c in kcols}
-    materialize_virtuals(plan.virtual_exprs, cols, nulls, np,
+    materialize_virtuals(kernel_virtuals(plan), cols, nulls, np,
                          wide_ints=False)
     env = {"cols": cols, "nulls": nulls}
     tc = _ConstTracker(plan.pool.consts)
     if filter_fn is not None:
         filter_fn(env, tc)
     for dp in plan.dim_plans:
-        dp.ids(env, tc, np)
+        if dp.kind in IN_KERNEL_DIM_KINDS:
+            dp.ids(env, tc, np)
     for p in plan.agg_plans:
         if p.filter_fn is not None:
             p.filter_fn(env, tc)
@@ -188,7 +212,10 @@ def column_bounds(plan, table) -> dict:
         if isinstance(cached, _Ineligible):
             raise cached
         return cached
-    md = table.column_metadata(set(key) or None)
+    if not key:  # e.g. count(*) grouped only by precomputed dims
+        cache[key] = {}
+        return {}
+    md = table.column_metadata(set(key))
     bounds = {}
     for c in key:
         typ = table.schema[c]
@@ -287,7 +314,7 @@ def eligible(query, plan, table, config, filter_fn=None) -> str | None:
     if table.num_rows > MAX_ROWS:
         return f"row count {table.num_rows} exceeds int32 headroom"
     for dp in plan.dim_plans:
-        if dp.kind not in ("codes", "numeric", "remap"):
+        if dp.kind not in ("codes", "numeric", "remap", "timeformat"):
             return f"dimension kind {dp.kind!r}"
     if not _filter_ok(query.filter):
         return "filter tree has non-simple members"
@@ -357,9 +384,11 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
     sizes = plan.sizes
     dim_plans = plan.dim_plans
     agg_plans = plan.agg_plans
-    vexprs = plan.virtual_exprs
+    vexprs = kernel_virtuals(plan)
     bucket_plan = plan.bucket_plan
     has_buckets = bucket_plan.kind != "all"
+    pre_dims = [dp.kind not in IN_KERNEL_DIM_KINDS for dp in dim_plans]
+    n_pre = (1 if has_buckets else 0) + sum(pre_dims)
     block_rows = table.block_rows
     rb = min(block_rows, config.pallas_rows_per_block)
     KB = min(K, config.pallas_k_per_block)
@@ -367,12 +396,12 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
     K_pad = n_kb * KB
 
     const_names = traced_const_names(plan, table, filter_fn)
-    col_names = [c for c in plan.columns if c != TIME_COLUMN]
+    col_names = [c for c in kernel_columns(plan) if c != TIME_COLUMN]
 
     def make_kernel_fn(null_names):
         def kernel_fn(*refs):
-            (col_refs, bucket_refs, null_refs, valid_ref, const_refs,
-             out_ref) = _split_refs(refs, len(col_names), has_buckets,
+            (col_refs, pre_refs, null_refs, valid_ref, const_refs,
+             out_ref) = _split_refs(refs, len(col_names), n_pre,
                                     len(null_names), len(const_names))
             kb = pl.program_id(0)
             step = pl.program_id(1)
@@ -398,10 +427,19 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
                 mask = mask & filter_fn(env, consts)
 
             # mixed-radix dense group key [rb]; the precomputed granularity
-            # bucket id is the most-significant digit (radix sizes[0])
-            key = bucket_refs[0][0, :] if has_buckets else None
-            for dp, size in zip(dim_plans, sizes[1:]):
-                i = dp.ids(env, consts, jnp).astype(jnp.int32)
+            # bucket id is the most-significant digit (radix sizes[0]);
+            # gather-needing dim ids arrive precomputed in dim order
+            pi = 0
+            key = None
+            if has_buckets:
+                key = pre_refs[pi][0, :]
+                pi += 1
+            for dp, is_pre, size in zip(dim_plans, pre_dims, sizes[1:]):
+                if is_pre:
+                    i = pre_refs[pi][0, :]
+                    pi += 1
+                else:
+                    i = dp.ids(env, consts, jnp).astype(jnp.int32)
                 key = i if key is None else key * jnp.int32(size) + i
             if key is None:
                 key = jnp.zeros((rb,), jnp.int32)
@@ -427,7 +465,11 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
                     m = m & ~nm
                 if bias:
                     v = v - jnp.int32(bias)  # shift into [0, hi-lo]
-                v = jnp.where(m, v, 0)
+                # strongly-typed zero: under x64 a Python 0 enters the
+                # where as a weak i64 scalar, and Mosaic's scalar i64->i32
+                # conversion recurses forever (observed on v5e; the CPU
+                # interpret path never lowers through Mosaic and hides it)
+                v = jnp.where(m, v, jnp.int32(0))
                 for j in range(n_planes):
                     h = (v >> (N_PLANE_BITS * j)) & PLANE_MASK
                     rows.append(h.astype(jnp.bfloat16)[None, :])
@@ -448,20 +490,27 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
             out_ref[:, :] += partial
         return kernel_fn
 
+    # index maps return strongly-typed int32 zeros: under x64 a literal 0
+    # traces as i64, and Mosaic rejects the index-map func.return with
+    # 64-bit operands ("failed to legalize func.return", v5e)
+    _z = np.int32(0)
+
     def row_spec():
-        return pl.BlockSpec((1, rb), lambda kb, i: (0, i))
+        return pl.BlockSpec((1, rb), lambda kb, i: (_z, i))
 
     def const_spec(n):
-        return pl.BlockSpec((1, n), lambda kb, i: (0, 0))
+        return pl.BlockSpec((1, n), lambda kb, i: (_z, _z))
 
     def fn(env, valid, seg_mask, consts):
         n_segments = valid.shape[0]
         n = n_segments * block_rows
         grid_rows = n // rb
-        null_names = sorted(c for c in env["nulls"] if c != TIME_COLUMN)
+        cset = set(col_names)
+        null_names = sorted(c for c in env["nulls"]
+                            if c != TIME_COLUMN and c in cset)
         mask = (valid & seg_mask[:, None]).reshape(-1)
-        bucket_in = []
-        if imask_fn is not None or has_buckets:
+        pre_in = []
+        if imask_fn is not None or n_pre:
             flat_env = {
                 "cols": {c: a.reshape(-1) for c, a in env["cols"].items()},
                 "nulls": {c: a.reshape(-1)
@@ -470,7 +519,11 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
                 mask = mask & imask_fn(flat_env, consts)
             if has_buckets:
                 b = bucket_plan.ids(flat_env["cols"][TIME_COLUMN], consts)
-                bucket_in.append(b.astype(jnp.int32).reshape(1, n))
+                pre_in.append(b.astype(jnp.int32).reshape(1, n))
+            for dp, is_pre in zip(dim_plans, pre_dims):
+                if is_pre:
+                    ids = dp.ids(flat_env, consts, jnp)
+                    pre_in.append(ids.astype(jnp.int32).reshape(1, n))
         mask2 = mask.reshape(1, n)
         col_in = [_narrow(env["cols"][c].reshape(1, n), jnp)
                   for c in col_names]
@@ -482,14 +535,14 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
             make_kernel_fn(null_names),
             grid=(n_kb, grid_rows),
             in_specs=([row_spec() for _ in col_in]
-                      + [row_spec() for _ in bucket_in]
+                      + [row_spec() for _ in pre_in]
                       + [row_spec() for _ in null_in]
                       + [row_spec()]
                       + [const_spec(c.shape[1]) for c in const_in]),
-            out_specs=pl.BlockSpec((KB, H_pad), lambda kb, i: (kb, 0)),
+            out_specs=pl.BlockSpec((KB, H_pad), lambda kb, i: (kb, _z)),
             out_shape=jax.ShapeDtypeStruct((K_pad, H_pad), jnp.int32),
             interpret=interpret,
-        )(*col_in, *bucket_in, *null_in, mask2, *const_in)
+        )(*col_in, *pre_in, *null_in, mask2, *const_in)
         out = out[:K]
 
         res = {"_rows": out[:, layout.rows_slot].astype(jnp.int64)}
@@ -498,30 +551,55 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
             if kind == "count":
                 res[name] = out[:, start].astype(p.acc_dtype)
             else:
-                acc = jnp.zeros((K,), jnp.int64)
+                # Plane recombination rides f64, NOT int64 shifts: on the
+                # v5e sandbox, a jit-fused  custom_call -> convert(i64) ->
+                # shift/mul  chain miscompiles (the converted values read
+                # as ZERO for a deterministic subset of rows; eager or
+                # plain-array runs of the identical expression are
+                # correct, and multiplies instead of shifts change
+                # nothing). f64 math forces the consumer out of the fused
+                # int pipeline and is exact here: each half-sum is below
+                # 15*MAX_ROWS*(16^3+16^2+16+1) < 2^53.
+                half = n_planes // 2  # planes [0, half) and [half, 8)
+                lo = jnp.zeros((K,), jnp.float64)
+                hi = jnp.zeros((K,), jnp.float64)
                 for j in range(n_planes):
-                    acc = acc + (out[:, start + j].astype(jnp.int64)
-                                 << (N_PLANE_BITS * j))
+                    w = float(1 << (N_PLANE_BITS * (j % half)))
+                    v = out[:, start + j].astype(jnp.float64) * w
+                    if j < half:
+                        lo = lo + v
+                    else:
+                        hi = hi + v
+                acc = lo.astype(jnp.int64) + (
+                    hi.astype(jnp.int64) << (N_PLANE_BITS * half))
                 if bias:
-                    n_masked = out[:, start + n_planes].astype(jnp.int64)
-                    acc = acc + jnp.int64(bias) * n_masked
+                    # same split for the bias un-shift: bias*n can exceed
+                    # 2^53, so do it in 16-bit halves of |bias|
+                    n_masked = out[:, start + n_planes].astype(jnp.float64)
+                    b = -bias  # bias < 0: inputs were shifted by -bias
+                    b_lo, b_hi = b & 0xFFFF, b >> 16
+                    sub = (n_masked * float(b_lo)).astype(jnp.int64) + (
+                        (n_masked * float(b_hi)).astype(jnp.int64) << 16)
+                    acc = acc - sub
                 res[name] = acc.astype(p.acc_dtype)
         return res
 
     return fn
 
 
-def _split_refs(refs, n_cols, has_buckets, n_nulls, n_consts):
+def _split_refs(refs, n_cols, n_pre, n_nulls, n_consts):
+    """n_pre: host-precomputed int32 id streams — the granularity bucket
+    (if any) followed by one stream per gather-needing dimension
+    (remap/timeformat), in dimension order."""
     refs = list(refs)
-    nb = 1 if has_buckets else 0
     cols = refs[:n_cols]
-    buckets = refs[n_cols:n_cols + nb]
-    nulls = refs[n_cols + nb:n_cols + nb + n_nulls]
-    valid = refs[n_cols + nb + n_nulls]
-    consts = refs[n_cols + nb + n_nulls + 1:
-                  n_cols + nb + n_nulls + 1 + n_consts]
+    pre = refs[n_cols:n_cols + n_pre]
+    nulls = refs[n_cols + n_pre:n_cols + n_pre + n_nulls]
+    valid = refs[n_cols + n_pre + n_nulls]
+    consts = refs[n_cols + n_pre + n_nulls + 1:
+                  n_cols + n_pre + n_nulls + 1 + n_consts]
     out = refs[-1]
-    return cols, buckets, nulls, valid, consts, out
+    return cols, pre, nulls, valid, consts, out
 
 
 def _narrow(x, jnp):
