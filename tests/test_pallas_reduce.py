@@ -63,14 +63,22 @@ QUERIES = [
 ]
 
 
-@pytest.mark.parametrize("sql", QUERIES)
-def test_pallas_parity(sql):
+def _assert_parity(sql, check_eligible=False):
     plain, forced = _engines()
     a = plain.sql(sql)
     assert plain.last_plan.rewritten
     b = forced.sql(sql)
     assert forced.last_plan.rewritten
+    if check_eligible:
+        plan = forced.planner.plan(sql)
+        phys = lower(plan.query, plan.entry.segments, forced.config)
+        assert phys.pallas_reason is None, phys.pallas_reason
     pd.testing.assert_frame_equal(a, b)
+
+
+@pytest.mark.parametrize("sql", QUERIES)
+def test_pallas_parity(sql):
+    _assert_parity(sql)
 
 
 def test_pallas_kernel_is_active():
@@ -143,15 +151,7 @@ WIDENED_QUERIES = [
 
 @pytest.mark.parametrize("sql", WIDENED_QUERIES)
 def test_pallas_widened_parity(sql):
-    plain, forced = _engines()
-    a = plain.sql(sql)
-    assert plain.last_plan.rewritten
-    b = forced.sql(sql)
-    assert forced.last_plan.rewritten
-    plan = forced.planner.plan(sql)
-    phys = lower(plan.query, plan.entry.segments, forced.config)
-    assert phys.pallas_reason is None, phys.pallas_reason
-    pd.testing.assert_frame_equal(a, b)
+    _assert_parity(sql, check_eligible=True)
 
 
 def test_pallas_k_tiling_parity():
@@ -193,3 +193,76 @@ def test_pallas_multichip_parity():
     a = plain.sql(q)
     b = forced.sql(q)
     pd.testing.assert_frame_equal(a, b)
+
+
+PRECOMPUTED_DIM_QUERIES = [
+    # IN-constrained string dim -> remap kind: ids are gathered on the
+    # host side (Mosaic cannot lower 1-D dynamic gathers) and streamed
+    # into the kernel as an int32 row input
+    """SELECT region, sum(price) AS s FROM t
+       WHERE region IN ('r1','r2','r3') GROUP BY region ORDER BY region""",
+    # substring extraction dim -> remap
+    """SELECT substr(region, 1, 2) AS r2, sum(price) AS s, count(*) AS n
+       FROM t GROUP BY substr(region, 1, 2) ORDER BY r2""",
+    # two timeformat dims (year + month) -> both precomputed
+    """SELECT year(ts) AS y, month(ts) AS mo, sum(price) AS s FROM t
+       GROUP BY year(ts), month(ts) ORDER BY y, mo""",
+    # mixed in-kernel (codes) + precomputed (timeformat) digits in one
+    # mixed-radix key — the SSB q2.1 shape that first failed on hardware
+    """SELECT year(ts) AS y, color, sum(price) AS s FROM t
+       GROUP BY year(ts), color ORDER BY y, color""",
+    # remap + codes + filter together
+    """SELECT substr(region, 1, 2) AS r2, color, sum(price) AS s FROM t
+       WHERE qty < 40 GROUP BY substr(region, 1, 2), color
+       ORDER BY r2, color""",
+]
+
+
+@pytest.mark.parametrize("sql", PRECOMPUTED_DIM_QUERIES)
+def test_pallas_precomputed_dim_parity(sql):
+    _assert_parity(sql, check_eligible=True)
+
+
+def test_pallas_precomputed_dim_kinds():
+    """The remap/timeformat dims really take the precomputed path (guards
+    against the planner silently reclassifying them as in-kernel)."""
+    _, forced = _engines()
+    q = """SELECT year(ts) AS y, color, sum(price) AS s FROM t
+           GROUP BY year(ts), color"""
+    plan = forced.planner.plan(q)
+    phys = lower(plan.query, plan.entry.segments, forced.config)
+    kinds = [dp.kind for dp in phys.dim_plans]
+    assert "timeformat" in kinds and "codes" in kinds, kinds
+    assert phys.pallas_reason is None
+
+
+def test_pallas_large_value_sums():
+    """Values spanning the full int32 range exercise every 4-bit plane and
+    the f64 half-sum recombination (the int64-shift recombination was
+    miscompiled on real hardware; interpret mode guards the math)."""
+    rng = np.random.default_rng(11)
+    n = 2048
+    df = pd.DataFrame({
+        "ts": pd.to_datetime("2021-01-01")
+        + pd.to_timedelta(rng.integers(0, 86400 * 30, n), unit="s"),
+        "g": rng.choice([f"g{i}" for i in range(7)], n),
+        "big": rng.integers(0, 2**31 - 1, n).astype(np.int64),
+        "neg": rng.integers(-(2**30), 2**30, n).astype(np.int64),
+    })
+    plain = Engine(EngineConfig(use_pallas="never"))
+    forced = Engine(EngineConfig(use_pallas="force"))
+    for e in (plain, forced):
+        e.register_table("big_t", df, time_column="ts", block_rows=512)
+    for q in (
+        "SELECT g, sum(big) AS s FROM big_t GROUP BY g ORDER BY g",
+        # negative values ride the biased half-plane path with a bias
+        # whose magnitude needs both 16-bit halves of the un-shift
+        "SELECT g, sum(neg) AS s FROM big_t GROUP BY g ORDER BY g",
+    ):
+        a = plain.sql(q)
+        b = forced.sql(q)
+        assert forced.last_plan.rewritten
+        plan = forced.planner.plan(q)
+        phys = lower(plan.query, plan.entry.segments, forced.config)
+        assert phys.pallas_reason is None, phys.pallas_reason
+        pd.testing.assert_frame_equal(a, b)

@@ -35,14 +35,17 @@ def log(rec):
         f.write(json.dumps(rec) + "\n")
 
 
-def attempt_bench(use_pallas: str | None = None):
+def attempt_bench(use_pallas: str | None = None, rows: int | None = None):
     """Run bench.py on the default backend. Returns (status, rec|None):
     status in {"tpu", "cpu", "timeout", "error"}."""
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
     env.pop("SSB_USE_PALLAS", None)  # a stale export must not leak into
     env["BENCH_SKIP_PROBE"] = "1"    # the banked headline (auto) run
-    env.setdefault("SSB_ROWS", "6000000")
+    if rows is not None:
+        env["SSB_ROWS"] = str(rows)
+    else:
+        env.setdefault("SSB_ROWS", "6000000")
     if use_pallas is not None:
         env["SSB_USE_PALLAS"] = use_pallas
     try:
@@ -65,10 +68,40 @@ def attempt_bench(use_pallas: str | None = None):
     return ("cpu" if backend == "cpu" else "tpu"), rec
 
 
+def tunnel_alive(timeout_s: float = 120) -> bool:
+    """Cheap liveness check: PJRT init in a subprocess with a timeout —
+    much cheaper than re-running the full headline bench once banked."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "print(d[0].platform if d else 'none')"],
+            timeout=timeout_s, capture_output=True, text=True, env=env)
+        return proc.returncode == 0 and "cpu" not in proc.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+# Extra measurements banked opportunistically after the headline: the
+# XLA-scatter leg of the Pallas comparison (the banked auto run IS the
+# Pallas leg: on TPU, auto uses the kernel for every eligible plan), and
+# the SF10 scale proof (dataset should be pre-generated under .ssb_data
+# so the up-window is spent ingesting + querying, not writing parquet).
+EXTRA_LEGS = [
+    ("pallas-never bench", "BENCH_TPU_PALLAS_never.json",
+     dict(use_pallas="never")),
+    ("sf10 bench", "BENCH_TPU_SF10.json", dict(rows=60_000_000)),
+]
+MAX_LEG_FAILURES = 2  # deterministic failures must not eat the window
+
+
 def main():
     start = time.time()
     n = 0
     banked = False
+    leg_failures = {fname: 0 for _, fname, _ in EXTRA_LEGS}
     if os.path.exists(BANK):
         with open(BANK) as f:
             banked = json.load(f).get("detail", {}).get("backend",
@@ -76,33 +109,48 @@ def main():
     while time.time() - start < TOTAL:
         n += 1
         t0 = time.time()
-        status, rec = attempt_bench()
-        log({"attempt": n, "status": status,
-             "elapsed_s": round(time.time() - t0, 1),
-             **({"error": rec} if status in ("error", "timeout") and rec
-                else {})})
-        if status == "tpu":
-            with open(BANK, "w") as f:
-                json.dump(rec, f, indent=1)
-            banked = True
-            log({"event": "banked TPU bench",
-                 "value": rec.get("value")})
-            # bank the XLA-scatter leg of the Pallas comparison while
-            # the tunnel is up (the banked auto run IS the Pallas leg:
-            # on TPU, auto uses the kernel for every eligible plan, and
-            # all 13 SSB queries are eligible). Skipped once banked —
-            # tunnel up-time is too scarce to re-measure hourly.
-            cmp_path = os.path.join(REPO, "BENCH_TPU_PALLAS_never.json")
-            if not os.path.exists(cmp_path):
-                s2, r2 = attempt_bench(use_pallas="never")
-                log({"event": "pallas-never bench", "status": s2,
+        if not banked:
+            status, rec = attempt_bench()
+            log({"attempt": n, "status": status,
+                 "elapsed_s": round(time.time() - t0, 1),
+                 **({"error": rec} if status in ("error", "timeout")
+                    and rec else {})})
+            if status == "tpu":
+                with open(BANK, "w") as f:
+                    json.dump(rec, f, indent=1)
+                banked = True
+                log({"event": "banked TPU bench", "value": rec.get("value")})
+            up = status == "tpu"
+        else:
+            up = tunnel_alive()
+            log({"attempt": n, "status": "alive" if up else "down",
+                 "elapsed_s": round(time.time() - t0, 1)})
+        if up:
+            for event, fname, kw in EXTRA_LEGS:
+                path = os.path.join(REPO, fname)
+                if os.path.exists(path) or \
+                        leg_failures[fname] >= MAX_LEG_FAILURES:
+                    continue
+                s2, r2 = attempt_bench(**kw)
+                log({"event": event, "status": s2,
                      "value": (r2 or {}).get("value"),
                      **({"error": r2} if s2 in ("error", "timeout")
                         and r2 else {})})
                 if s2 == "tpu":
-                    with open(cmp_path, "w") as f:
+                    with open(path, "w") as f:
                         json.dump(r2, f, indent=1)
-        time.sleep(PERIOD if not banked else max(PERIOD, 3600))
+                elif s2 == "timeout" and not tunnel_alive():
+                    break  # tunnel closed mid-run; retry next cycle
+                else:
+                    # deterministic error, or a leg too slow for the
+                    # attempt timeout while the tunnel is still up: cap
+                    # it so it cannot eat the whole window
+                    leg_failures[fname] += 1
+        legs_done = all(
+            os.path.exists(os.path.join(REPO, f))
+            or leg_failures[f] >= MAX_LEG_FAILURES
+            for _, f, _ in EXTRA_LEGS)
+        time.sleep(max(PERIOD, 3600) if banked and legs_done else PERIOD)
     log({"event": "probe loop done", "attempts": n, "banked": banked})
 
 
