@@ -119,8 +119,16 @@ def _gen_query(rng):
     if n_filters:
         fs = list(rng.choice(_FILTERS, size=n_filters, replace=False))
         sql += " WHERE " + " AND ".join(f"({f})" for f in fs)
+    # ordinals: group keys occupy select positions 1..len(group) (the
+    # select list was built dims-first in the same order), so GROUP BY /
+    # ORDER BY may legally reference them by position
+    use_ordinals = bool(group) and not distinct and rng.random() < 0.2
     if group:
-        sql += " GROUP BY " + ", ".join(group)
+        if use_ordinals:
+            sql += " GROUP BY " + ", ".join(
+                str(i + 1) for i in range(len(group)))
+        else:
+            sql += " GROUP BY " + ", ".join(group)
         if rng.random() < 0.3:
             sql += f" HAVING {aggs[0][1]} > 0"
     if rng.random() < 0.5 and group:
@@ -134,12 +142,17 @@ def _gen_query(rng):
                 keys.append("xd")
             else:
                 keys.append("tg")
+        if use_ordinals and rng.random() < 0.5:
+            keys = [str(i + 1) for i in range(len(group))]
         direction = "DESC" if rng.random() < 0.5 else "ASC"
         sql += " ORDER BY " + ", ".join(f"{k} {direction}" for k in keys)
         if rng.random() < 0.5:
             sql += f" LIMIT {int(rng.integers(1, 30))}"
             if rng.random() < 0.4:
                 sql += f" OFFSET {int(rng.integers(0, 10))}"
+    if rng.random() < 0.08:
+        # CTE wrap: exercises WITH-inlining + the derived-table fallback
+        sql = f"WITH q AS ({sql}) SELECT * FROM q"
     return sql
 
 

@@ -5,6 +5,7 @@ and execute on the fallback interpreter instead of raising SqlError."""
 
 import numpy as np
 import pandas as pd
+import pytest
 
 from tpu_olap import Engine
 
@@ -310,3 +311,153 @@ def test_subquery_inside_window_spec():
     got = eng.sql("SELECT g, v, rank() OVER "
                   "(ORDER BY v - (SELECT min(v) FROM t)) AS r FROM t")
     assert int(got.loc[got["v"].idxmin(), "r"]) == 1
+
+
+def test_cte_basic():
+    eng, df = _engine()
+    got = eng.sql("WITH x AS (SELECT g, sum(v) AS s FROM t GROUP BY g) "
+                  "SELECT g, s FROM x WHERE s > 0 ORDER BY g")
+    want = df.groupby("g", as_index=False)["v"].sum() \
+             .rename(columns={"v": "s"}).sort_values("g", ignore_index=True)
+    pd.testing.assert_frame_equal(got, want)
+
+
+def test_cte_chained():
+    """A later CTE may reference an earlier one."""
+    eng, df = _engine()
+    got = eng.sql(
+        "WITH base AS (SELECT g, v FROM t WHERE v >= 100), "
+        "     agg AS (SELECT g, count(*) AS n FROM base GROUP BY g) "
+        "SELECT g, n FROM agg ORDER BY g")
+    want = (df[df.v >= 100].groupby("g", as_index=False).size()
+            .rename(columns={"size": "n"}).sort_values("g",
+                                                       ignore_index=True))
+    want["n"] = want["n"].astype("int64")
+    pd.testing.assert_frame_equal(got, want)
+
+
+def test_cte_referenced_twice():
+    eng, df = _engine()
+    got = eng.sql(
+        "WITH x AS (SELECT g, sum(v) AS s FROM t GROUP BY g) "
+        "SELECT g, s FROM x WHERE s >= (SELECT max(s) FROM x) ORDER BY g")
+    sums = df.groupby("g")["v"].sum()
+    assert got["g"].tolist() == [sums.idxmax()]
+
+
+def test_cte_in_join_rejected_clearly():
+    import pytest as _pytest
+
+    from tpu_olap.planner.sqlparse import SqlError
+    eng, _ = _engine()
+    with _pytest.raises(SqlError, match="CTE 'x' referenced in a JOIN"):
+        eng.sql("WITH x AS (SELECT g FROM t) "
+                "SELECT t.g FROM t JOIN x ON t.g = x.g")
+
+
+def test_group_by_ordinal():
+    eng, df = _engine()
+    got = eng.sql("SELECT g, count(*) AS n FROM t GROUP BY 1 ORDER BY g")
+    want = eng.sql("SELECT g, count(*) AS n FROM t GROUP BY g ORDER BY g")
+    pd.testing.assert_frame_equal(got, want)
+
+
+def test_order_by_ordinal():
+    """ORDER BY 2 sorts by the 2nd projection, not by the constant 2."""
+    eng, df = _engine()
+    got = eng.sql("SELECT g, sum(v) AS s FROM t GROUP BY g ORDER BY 2")
+    assert got["s"].is_monotonic_increasing
+    got = eng.sql("SELECT g, sum(v) AS s FROM t GROUP BY g ORDER BY 2 DESC")
+    assert got["s"].is_monotonic_decreasing
+
+
+def test_ordinal_out_of_range():
+    import pytest as _pytest
+
+    from tpu_olap.planner.sqlparse import SqlError
+    eng, _ = _engine()
+    with _pytest.raises(SqlError, match="ordinal 7 out of range"):
+        eng.sql("SELECT g FROM t ORDER BY 7")
+    with _pytest.raises(SqlError, match="cannot be resolved with SELECT"):
+        eng.sql("SELECT * FROM t ORDER BY 1")
+
+
+FILTER_QUERIES = [
+    "SELECT g, sum(v) FILTER (WHERE city = 'c1') AS sx, count(*) AS n "
+    "FROM t GROUP BY g ORDER BY g",
+    "SELECT g, count(*) FILTER (WHERE v > 250) AS nh, sum(v) AS s "
+    "FROM t GROUP BY g ORDER BY g",
+    "SELECT g, avg(v) FILTER (WHERE city IN ('c1','c2')) AS af "
+    "FROM t GROUP BY g ORDER BY g",
+    "SELECT g, sum(v) FILTER (WHERE city = 'c0') AS sx, sum(v) AS s "
+    "FROM t GROUP BY g ORDER BY g",
+    "SELECT g, count(v) FILTER (WHERE city = 'c3') AS cv "
+    "FROM t GROUP BY g ORDER BY g",
+    "SELECT g, count_distinct(v) FILTER (WHERE city = 'c2') AS dx "
+    "FROM t GROUP BY g ORDER BY g",
+    "SELECT sum(v) FILTER (WHERE g = 'a') AS sa, "
+    "sum(v) FILTER (WHERE g = 'b') AS sb FROM t",
+]
+
+
+@pytest.mark.parametrize("sql", FILTER_QUERIES)
+def test_agg_filter_parity(sql):
+    from tpu_olap.bench.parity import assert_frame_parity
+    eng, df = _engine()
+    dev = eng.sql(sql)
+    assert eng.last_plan.rewritten, eng.last_plan.fallback_reason
+    from tpu_olap.planner.fallback import execute_fallback
+    ref = execute_fallback(eng.planner.plan(sql).stmt, eng.catalog,
+                           eng.config)
+    # count_distinct is approximate on the device path (HLL) and exact
+    # on the fallback — the parity harness gets the standard tolerance
+    assert_frame_parity(dev, ref, approx_cols=("dx",))
+
+
+def test_agg_filter_oracle():
+    """Absolute check against pandas (device and fallback are independent
+    implementations, but pin the ground truth anyway)."""
+    eng, df = _engine()
+    got = eng.sql("SELECT g, sum(v) FILTER (WHERE city = 'c1') AS sx "
+                  "FROM t GROUP BY g ORDER BY g")
+    want = (df[df.city == "c1"].groupby("g")["v"].sum()
+            .reindex(sorted(df.g.unique())).fillna(0).astype("int64"))
+    assert got["sx"].tolist() == want.tolist()
+
+
+def test_agg_filter_chunked(tmp_path):
+    """FILTER aggregates through the chunked (streamed) fallback."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from tpu_olap.executor import EngineConfig
+    df = _df(4000)
+    p = str(tmp_path / "f.parquet")
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), p)
+    eng = Engine(EngineConfig(fallback_chunk_rows=100,
+                              fallback_chunk_batch_rows=512))
+    eng.register_table("t", p, time_column="ts", accelerate=False)
+    sql = ("SELECT g, sum(v) FILTER (WHERE city = 'c1') AS sx, "
+           "avg(v) FILTER (WHERE city = 'c2') AS ax, "
+           "count(*) FILTER (WHERE v > 250) AS nh, "
+           "count_distinct(v) FILTER (WHERE city = 'c0') AS dx "
+           "FROM t GROUP BY g ORDER BY g")
+    got = eng.sql(sql)
+    c1 = df[df.city == "c1"].groupby("g")["v"].sum()
+    gs = sorted(df.g.unique())
+    assert got["g"].tolist() == gs
+    want_sx = c1.reindex(gs).fillna(0).astype("int64").tolist()
+    assert got["sx"].tolist() == want_sx
+    want_ax = df[df.city == "c2"].groupby("g")["v"].mean().reindex(gs)
+    for a, b in zip(got["ax"].tolist(), want_ax.tolist()):
+        assert (pd.isna(a) and pd.isna(b)) or abs(a - b) < 1e-9
+    want_dx = (df[df.city == "c0"].groupby("g")["v"].nunique()
+               .reindex(gs).fillna(0).astype("int64").tolist())
+    assert got["dx"].tolist() == want_dx
+
+
+def test_filter_after_non_aggregate_rejected():
+    from tpu_olap.planner.sqlparse import SqlError
+    eng, _ = _engine()
+    with pytest.raises(SqlError, match="FILTER only follows an aggregate"):
+        eng.sql("SELECT substr(g, 1, 1) FILTER (WHERE v > 0) AS x FROM t")

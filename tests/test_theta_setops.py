@@ -1,0 +1,147 @@
+"""Theta sketch set operations (INTERSECT / UNION / NOT post-aggs) — the
+datasketches-extension capability that motivates theta over HLL
+(SURVEY.md §3.3). Sketches below stay under their nominal k, so every
+estimate is EXACT and compares against a pandas oracle with zero
+tolerance."""
+
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpu_olap import Engine
+from tpu_olap.executor import EngineConfig
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(5)
+    n = 6000
+    df = pd.DataFrame({
+        "ts": pd.to_datetime("2024-03-01")
+        + pd.to_timedelta(rng.integers(0, 86400 * 20, n), unit="s"),
+        "user": rng.integers(0, 800, n).astype(np.int64),
+        "action": rng.choice(["buy", "view", "share"], n),
+        "device": rng.choice(["ios", "android"], n),
+    })
+    eng = Engine(EngineConfig())
+    eng.register_table("events", df, time_column="ts")
+    return eng, df
+
+
+def _theta(name, filt=None):
+    agg = {"type": "thetaSketch", "name": name, "fieldName": "user",
+           "size": 4096}
+    if filt is None:
+        return agg
+    return {"type": "filtered", "name": name,
+            "filter": {"type": "selector", "dimension": "action",
+                       "value": filt},
+            "aggregator": agg}
+
+
+def _run(eng, post_aggs):
+    spec = json.dumps({
+        "queryType": "timeseries",
+        "granularity": "all",
+        "aggregations": [_theta("buyers", "buy"), _theta("viewers", "view"),
+                         _theta("sharers", "share")],
+        "postAggregations": post_aggs,
+    })
+    return eng.sql(f"ON DRUID DATASOURCE events EXECUTE QUERY '{spec}'")
+
+
+def _setop(name, func, *fields):
+    return {"type": "thetaSketchEstimate", "name": name,
+            "field": {"type": "thetaSketchSetOp", "func": func,
+                      "fields": [{"type": "fieldAccess", "fieldName": f}
+                                 for f in fields]}}
+
+
+def test_intersect(setup):
+    eng, df = setup
+    out = _run(eng, [_setop("both", "INTERSECT", "buyers", "viewers")])
+    buyers = set(df[df.action == "buy"].user)
+    viewers = set(df[df.action == "view"].user)
+    assert int(out["both"][0]) == len(buyers & viewers)
+
+
+def test_union(setup):
+    eng, df = setup
+    out = _run(eng, [_setop("any2", "UNION", "buyers", "sharers")])
+    buyers = set(df[df.action == "buy"].user)
+    sharers = set(df[df.action == "share"].user)
+    assert int(out["any2"][0]) == len(buyers | sharers)
+
+
+def test_not(setup):
+    eng, df = setup
+    out = _run(eng, [_setop("only_buy", "NOT", "buyers", "viewers")])
+    buyers = set(df[df.action == "buy"].user)
+    viewers = set(df[df.action == "view"].user)
+    assert int(out["only_buy"][0]) == len(buyers - viewers)
+
+
+def test_nested_and_three_way(setup):
+    eng, df = setup
+    nested = {"type": "thetaSketchEstimate", "name": "triple", "field": {
+        "type": "thetaSketchSetOp", "func": "INTERSECT",
+        "fields": [
+            {"type": "fieldAccess", "fieldName": "buyers"},
+            {"type": "thetaSketchSetOp", "func": "UNION",
+             "fields": [{"type": "fieldAccess", "fieldName": "viewers"},
+                        {"type": "fieldAccess", "fieldName": "sharers"}]},
+        ]}}
+    out = _run(eng, [nested])
+    buyers = set(df[df.action == "buy"].user)
+    viewers = set(df[df.action == "view"].user)
+    sharers = set(df[df.action == "share"].user)
+    assert int(out["triple"][0]) == len(buyers & (viewers | sharers))
+
+
+def test_setop_in_groupby(setup):
+    """Per-group set ops: one sketch pair per device value."""
+    eng, df = setup
+    spec = json.dumps({
+        "queryType": "groupBy",
+        "granularity": "all",
+        "dimensions": ["device"],
+        "aggregations": [_theta("buyers", "buy"), _theta("viewers", "view")],
+        "postAggregations": [_setop("both", "INTERSECT",
+                                    "buyers", "viewers")],
+    })
+    out = eng.sql(f"ON DRUID DATASOURCE events EXECUTE QUERY '{spec}'")
+    for _, row in out.iterrows():
+        sub = df[df.device == row["device"]]
+        want = len(set(sub[sub.action == "buy"].user)
+                   & set(sub[sub.action == "view"].user))
+        assert int(row["both"]) == want
+
+
+def test_setop_arithmetic(setup):
+    """Set-op estimates compose with arithmetic post-aggs (overlap %)."""
+    eng, df = setup
+    post = [
+        _setop("both", "INTERSECT", "buyers", "viewers"),
+        _setop("any", "UNION", "buyers", "viewers"),
+        {"type": "arithmetic", "name": "jaccard", "fn": "/",
+         "fields": [{"type": "fieldAccess", "fieldName": "both"},
+                    {"type": "fieldAccess", "fieldName": "any"}]},
+    ]
+    out = _run(eng, post)
+    buyers = set(df[df.action == "buy"].user)
+    viewers = set(df[df.action == "view"].user)
+    want = len(buyers & viewers) / len(buyers | viewers)
+    assert abs(float(out["jaccard"][0]) - want) < 1e-9
+
+
+def test_json_round_trip():
+    from tpu_olap.ir.postaggs import postagg_from_json
+    d = {"type": "thetaSketchEstimate", "name": "e", "field": {
+        "type": "thetaSketchSetOp", "func": "NOT", "name": "",
+        "fields": [{"type": "fieldAccess", "fieldName": "a"},
+                   {"type": "fieldAccess", "fieldName": "b"}]}}
+    pa = postagg_from_json(d)
+    assert pa.to_json()["field"]["func"] == "NOT"
+    assert pa.inputs() == {"a", "b"}

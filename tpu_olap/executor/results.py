@@ -27,12 +27,15 @@ def agg_specs_by_name(aggs) -> dict:
     return out
 
 
-def finalize_aggs(partials: dict, agg_plans, specs_by_name) -> dict:
+def finalize_aggs(partials: dict, agg_plans, specs_by_name,
+                  keep_raw=frozenset()) -> dict:
     """Device partials -> {name: np array [K]} of final values.
 
     Sketches are finalized to numeric estimates here (Druid finalizes at
     the broker; our 'broker' is this host step). min/max of empty groups
     become NaN (rendered as null); sums/counts of empty groups are 0.
+    Theta aggregators named in `keep_raw` additionally retain their raw
+    [K, k] hash tables (under "_theta_raw_<name>") for set-op post-aggs.
     """
     out = {"_rows": np.asarray(partials["_rows"])}
     for p in agg_plans:
@@ -55,10 +58,99 @@ def finalize_aggs(partials: dict, agg_plans, specs_by_name) -> dict:
             out[p.name] = est
             continue
         if p.kind == "theta":
+            if p.name in keep_raw:
+                out[f"_theta_raw_{p.name}"] = v
             out[p.name] = theta_estimate(v)
             continue
         raise AssertionError(p.kind)
     return out
+
+
+def theta_raw_fields(post_aggs) -> set:
+    """Theta aggregator names whose RAW sketch tables the post-aggs need
+    (referenced from a set-op tree). Non-empty => the query must take an
+    execution path that ships raw tables to the host (not the packed
+    single-fetch path, which finalizes sketches on device)."""
+    out: set = set()
+
+    def walk(pa):
+        if isinstance(pa, P.ThetaSketchSetOpPostAgg):
+            for f in pa.fields:
+                if isinstance(f, P.ThetaSketchSetOpPostAgg):
+                    walk(f)
+                else:
+                    out.add(f.field_name)
+        elif isinstance(pa, P.ThetaSketchEstimatePostAgg) and \
+                pa.field is not None:
+            walk(pa.field)
+        elif isinstance(pa, P.ArithmeticPostAgg):
+            for f in pa.fields:
+                walk(f)
+
+    for pa in post_aggs:
+        walk(pa)
+    return out
+
+
+_THETA_EMPTY = 1.0  # kernels.theta.EMPTY
+
+
+def _row_member(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Per-row membership: mask[i, j] = a[i, j] in b[i, :]. Both are
+    row-sorted ascending with 1.0 empty-padding. One flat searchsorted
+    via a row-offset trick — done in EXACT int64 space: unit hashes are
+    2^-32 multiples (kernels.hashing.to_unit_float), so scaling by 2^32
+    recovers the integer hash losslessly, and a 2^33 row stride keeps
+    rows disjoint without eating mantissa bits (a float offset would
+    merge adjacent hashes past ~2^20 rows)."""
+    K = a.shape[0]
+    ai = np.round(a * float(1 << 32)).astype(np.int64)
+    bi = np.round(b * float(1 << 32)).astype(np.int64)
+    off = np.arange(K, dtype=np.int64)[:, None] << 33
+    bf = (bi + off).reshape(-1)
+    af = (ai + off).reshape(-1)
+    idx = np.searchsorted(bf, af)
+    idx = np.minimum(idx, bf.size - 1)
+    return (bf[idx] == af).reshape(a.shape)
+
+
+def _theta_eval(pa, arrays):
+    """Set-op tree -> (row-sorted table [K, k'], theta [K]). Leaves are
+    raw theta tables; theta of a leaf is its k-th smallest when full,
+    else 1.0 (exact mode)."""
+    if isinstance(pa, P.ThetaSketchSetOpPostAgg):
+        parts = [_theta_eval(f, arrays) for f in pa.fields]
+        tables = [t for t, _ in parts]
+        theta = np.minimum.reduce([th for _, th in parts])
+        a = tables[0]
+        if pa.func == "UNION":
+            merged = np.sort(np.concatenate(tables, axis=-1), axis=-1)
+            dup = np.concatenate(
+                [np.zeros_like(merged[..., :1], bool),
+                 merged[..., 1:] == merged[..., :-1]], axis=-1)
+            merged = np.where(dup, _THETA_EMPTY, merged)
+            return np.sort(merged, axis=-1), theta
+        keep = np.ones(a.shape, bool)
+        for b in tables[1:]:
+            m = _row_member(a, b)
+            keep &= m if pa.func == "INTERSECT" else ~m
+        return np.sort(np.where(keep, a, _THETA_EMPTY), axis=-1), theta
+    # leaf: FieldAccess to a theta aggregator's raw table
+    raw = arrays.get(f"_theta_raw_{pa.field_name}")
+    if raw is None:
+        raise ValueError(
+            f"theta set op references {pa.field_name!r}, which is not a "
+            "theta sketch aggregator of this query")
+    t = np.asarray(raw, np.float64)
+    full = (t < _THETA_EMPTY).all(axis=-1)
+    theta = np.where(full, t[..., -1], 1.0)
+    return t, theta
+
+
+def _theta_setop_estimate(pa, arrays) -> np.ndarray:
+    table, theta = _theta_eval(pa, arrays)
+    count = (table < theta[:, None]).sum(axis=-1)
+    return count / np.maximum(theta, 1e-30)
 
 
 def eval_post_aggs(arrays: dict, post_aggs) -> None:
@@ -73,6 +165,11 @@ def _eval_pa(pa, arrays):
         return np.asarray(arrays[pa.field_name], np.float64)
     if isinstance(pa, P.ConstantPostAgg):
         return np.float64(pa.value)
+    if isinstance(pa, P.ThetaSketchEstimatePostAgg) and pa.field is not None:
+        return _theta_setop_estimate(pa.field, arrays)
+    if isinstance(pa, P.ThetaSketchSetOpPostAgg):
+        # referenced directly (no estimate wrapper): render its estimate
+        return _theta_setop_estimate(pa, arrays)
     if isinstance(pa, (P.HyperUniqueCardinalityPostAgg,
                        P.ThetaSketchEstimatePostAgg)):
         # sketches are already finalized to numbers in finalize_aggs

@@ -21,7 +21,7 @@ from tpu_olap.executor.packing import (build_packer, densify, make_layout,
                                        unpack)
 from tpu_olap.executor.results import (agg_specs_by_name, eval_having,
                                        eval_post_aggs, finalize_aggs, iso,
-                                       render_value)
+                                       render_value, theta_raw_fields)
 from tpu_olap.ir.query import (GroupByQuerySpec, ScanQuerySpec,
                                SearchQuerySpec, SegmentMetadataQuerySpec,
                                SelectQuerySpec, TimeBoundaryQuerySpec,
@@ -574,13 +574,17 @@ class QueryRunner:
         plan = lower(query, table, self.config)
         metrics["lower_ms"] = (time.perf_counter() - t0) * 1000
         specs = agg_specs_by_name(query.aggregations)
+        # theta set-op post-aggs consume RAW sketch tables host-side;
+        # the packed path finalizes sketches on device, so those queries
+        # ride the unpacked per-array fetch instead
+        keep_raw = theta_raw_fields(query.post_aggregations)
 
         if plan.sparse:
             from tpu_olap.kernels.sparse_groupby import SENTINEL
             out, count = self._dispatch(
                 lambda: self._run_sparse(plan, metrics), metrics, table.name)
             t0 = time.perf_counter()
-            arrays = finalize_aggs(out, plan.agg_plans, specs)
+            arrays = finalize_aggs(out, plan.agg_plans, specs, keep_raw)
             eval_post_aggs(arrays, query.post_aggregations)
             names = self._out_names(query)
             # present groups by sentinel mask: compact tables fill the
@@ -595,7 +599,7 @@ class QueryRunner:
             return res
 
         packed = None
-        if self.config.platform != "cpu":
+        if self.config.platform != "cpu" and not keep_raw:
             packed = self._dispatch(
                 lambda: self._run_packed(plan, metrics), metrics,
                 table.name)
@@ -614,7 +618,8 @@ class QueryRunner:
                 lambda: self._run_partials(plan, metrics), metrics,
                 table.name)
             t0 = time.perf_counter()
-            arrays = finalize_aggs(partials, plan.agg_plans, specs)
+            arrays = finalize_aggs(partials, plan.agg_plans, specs,
+                                   keep_raw)
         eval_post_aggs(arrays, query.post_aggregations)
         if isinstance(query, TimeseriesQuerySpec):
             res = self._assemble_timeseries(query, plan, arrays)

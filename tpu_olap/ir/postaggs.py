@@ -101,21 +101,74 @@ class HyperUniqueCardinalityPostAgg(PostAggregationSpec):
 @register("postAggregation", "thetaSketchEstimate")
 @dataclass(frozen=True)
 class ThetaSketchEstimatePostAgg(PostAggregationSpec):
-    field_name: str
+    """Finalize a theta sketch to a number. `field_name` references a
+    theta aggregator directly; `field` (mutually exclusive) nests a
+    thetaSketchSetOp tree, matching the datasketches extension."""
+    field_name: str = ""
     name: str = ""
+    field: PostAggregationSpec | None = None
 
     def inputs(self):
-        return {self.field_name}
+        return self.field.inputs() if self.field is not None \
+            else {self.field_name}
 
     def to_json(self):
+        fld = (self.field.to_json() if self.field is not None else
+               {"type": "fieldAccess", "fieldName": self.field_name})
         return {"type": "thetaSketchEstimate", "name": self.name,
-                "field": {"type": "fieldAccess", "fieldName": self.field_name}}
+                "field": fld}
 
     @staticmethod
     def from_json(d):
         fld = d.get("field", {})
+        if fld.get("type") == "thetaSketchSetOp":
+            return ThetaSketchEstimatePostAgg(
+                "", d.get("name", ""), ThetaSketchSetOpPostAgg.from_json(fld))
         fn = d.get("fieldName") or fld.get("fieldName")
         return ThetaSketchEstimatePostAgg(fn, d.get("name", ""))
+
+
+@register("postAggregation", "thetaSketchSetOp")
+@dataclass(frozen=True)
+class ThetaSketchSetOpPostAgg(PostAggregationSpec):
+    """INTERSECT / UNION / NOT over theta sketches (the datasketches
+    extension's set operations — the reason to choose theta over HLL,
+    SURVEY.md §3.3). `fields` entries are FieldAccessPostAgg references
+    to theta aggregators or nested set ops. NOT is left-fold A \\ B \\ C.
+    Executed host-side on the raw per-group hash tables (the broker-side
+    finalize analog); referenced aggregators keep their raw tables
+    through finalization."""
+    func: str                       # "INTERSECT" | "UNION" | "NOT"
+    fields: tuple
+    name: str = ""
+
+    def inputs(self):
+        out = set()
+        for f in self.fields:
+            out |= f.inputs()
+        return out
+
+    def to_json(self):
+        return {"type": "thetaSketchSetOp", "name": self.name,
+                "func": self.func,
+                "fields": [f.to_json() for f in self.fields]}
+
+    @staticmethod
+    def from_json(d):
+        fields = []
+        for f in d.get("fields", ()):
+            if f.get("type") == "thetaSketchSetOp":
+                fields.append(ThetaSketchSetOpPostAgg.from_json(f))
+            else:
+                fields.append(FieldAccessPostAgg(f["fieldName"],
+                                                 f.get("name", "")))
+        func = d["func"].upper()
+        if func not in ("INTERSECT", "UNION", "NOT"):
+            raise ValueError(f"unknown theta set op {d['func']!r}")
+        if len(fields) < 2:
+            raise ValueError("thetaSketchSetOp needs at least 2 fields")
+        return ThetaSketchSetOpPostAgg(func, tuple(fields),
+                                       d.get("name", ""))
 
 
 def postagg_from_json(d):

@@ -248,6 +248,11 @@ def _aggregate(df, exprs, out_names, group_exprs, stmt, time_col):
 
     def agg_series(e, sub):
         if isinstance(e, FuncCall) and e.name in AGG_FUNCS:
+            if e.name == "agg_filter":
+                inner, cond = e.args
+                m = _eval(cond, sub, time_col)
+                m = pd.Series(m, index=sub.index).fillna(False).astype(bool)
+                return agg_series(inner, sub[m])
             if e.name == "count" and not e.args:
                 return len(sub)
             if e.name == "count":
@@ -500,7 +505,14 @@ def _chunked_aggregate(stmt, chunks, exprs, out_names, group_exprs,
     gcols = [f"__g{i}" for i in range(len(group_exprs))]
     gname_of = {_k(g): n for g, n in zip(group_exprs, gcols)}
     merge_ops: dict = {"__rows": "sum"}
-    distinct_keys = [k for k, e in specs if e.name in (
+
+    def _unwrap(e):
+        """agg_filter(inner, cond) -> (inner, cond); plain -> (e, None)."""
+        if e.name == "agg_filter":
+            return e.args[0], e.args[1]
+        return e, None
+
+    distinct_keys = [k for k, e in specs if _unwrap(e)[0].name in (
         "count_distinct", "approx_count_distinct", "theta_sketch")]
 
     def chunk_partial(df):
@@ -511,25 +523,43 @@ def _chunked_aggregate(stmt, chunks, exprs, out_names, group_exprs,
         work["__rows"] = np.ones(len(df), np.int64)
         dpairs = {}
         for i, (k, e) in enumerate(specs):
+            e, cond = _unwrap(e)
+            mask = None
+            if cond is not None:
+                mask = pd.Series(_eval(cond, df, time_col),
+                                 index=df.index).fillna(False).astype(bool)
             if e.name in ("count_distinct", "approx_count_distinct",
                           "theta_sketch"):
+                sub = df if mask is None else df[mask]
+                gsub = {n: (work[n] if mask is None else work[n][mask])
+                        for n in gcols}
                 cols = dict(
-                    {n: work[n] for n in gcols},
-                    **{f"v{j}": _eval_agg_input(a, df, time_col)
+                    gsub,
+                    **{f"v{j}": _eval_agg_input(a, sub, time_col)
                        for j, a in enumerate(e.args)})
                 p = pd.DataFrame(cols).dropna(
                     subset=[f"v{j}" for j in range(len(e.args))])
                 dpairs[k] = p.drop_duplicates()
                 continue
             if e.name == "count" and not e.args:
-                continue  # __rows covers it
+                if mask is not None:  # filtered row count
+                    work[f"p{i}"] = mask.astype(np.int64)
+                    merge_ops[f"p{i}"] = "sum"
+                continue  # unfiltered: __rows covers it
             v = _eval_agg_input(e.args[0], df, time_col)
+            if mask is not None:
+                v = v.where(mask)
             if e.name == "count":
+                # v.where(mask) above already nulled masked-out rows
                 work[f"p{i}"] = v.notna().astype(np.int64)
                 merge_ops[f"p{i}"] = "sum"
             elif e.name in ("sum", "avg"):
                 work[f"p{i}"] = v
                 merge_ops[f"p{i}"] = "sum"
+                if e.name == "avg" and mask is not None:
+                    # filtered avg denominator: filtered row count
+                    work[f"p{i}n"] = mask.astype(np.int64)
+                    merge_ops[f"p{i}n"] = "sum"
             elif e.name in ("min", "max"):
                 work[f"p{i}"] = v
                 merge_ops[f"p{i}"] = e.name
@@ -626,15 +656,18 @@ def _chunked_aggregate(stmt, chunks, exprs, out_names, group_exprs,
 
     def merged_agg(e, row, gkey):
         k = _k(e)
-        if e.name in ("count_distinct", "approx_count_distinct",
-                      "theta_sketch"):
+        inner, cond = _unwrap(e)
+        if inner.name in ("count_distinct", "approx_count_distinct",
+                          "theta_sketch"):
             return dcounts[k].get(_norm_key(gkey), 0)
-        if e.name == "count" and not e.args:
-            return int(row["__rows"])
-        if e.name == "count":
+        if inner.name == "count" and not inner.args:
+            return int(row[spec_col[k]] if cond is not None
+                       else row["__rows"])
+        if inner.name == "count":
             return int(row[spec_col[k]])
-        if e.name == "avg":
-            r = int(row["__rows"])
+        if inner.name == "avg":
+            r = int(row[spec_col[k] + "n"] if cond is not None
+                    else row["__rows"])
             return row[spec_col[k]] / r if r else np.nan
         return row[spec_col[k]]
 

@@ -665,10 +665,57 @@ class _Rewriter:
             self.aggs.append(CountAggregation(c))
             self.postaggs.append(ArithmeticPostAgg(
                 name, "/", (FieldAccessPostAgg(s), FieldAccessPostAgg(c))))
+        elif fn == "agg_filter":
+            # standard-SQL `agg(...) FILTER (WHERE cond)` -> the IR's
+            # FilteredAggregation (SURVEY.md §3.3 "filtered aggregator")
+            self._make_filtered_agg(e, name)
         else:
             raise RewriteError(f"unknown aggregate {fn!r}")
         self._agg_by_key[k] = name
         return name
+
+    def _make_filtered_agg(self, e: FuncCall, name: str) -> None:
+        import dataclasses
+
+        from tpu_olap.ir.aggregations import FilteredAggregation
+        inner, cond = e.args
+        if not isinstance(inner, FuncCall) or inner.name == "agg_filter":
+            raise RewriteError("FILTER must wrap a single plain aggregate")
+        fs = self._to_filter(cond)
+        if inner.name == "avg":
+            # filtered avg = filtered sum / filtered row count
+            fieldn, vt = self._agg_field(inner.args[0])
+            s = next(self._names)
+            c = next(self._names)
+            self.aggs.append(FilteredAggregation(
+                fs, SumAggregation(s, fieldn, vt)))
+            self.aggs.append(FilteredAggregation(fs, CountAggregation(c)))
+            self.postaggs.append(ArithmeticPostAgg(
+                name, "/", (FieldAccessPostAgg(s), FieldAccessPostAgg(c))))
+            return
+        # build the inner spec through the normal path, then re-own it:
+        # pop it if newly created (and forget its dedup entry so a later
+        # unfiltered use gets its own), or clone it if it was shared
+        ik = _key(inner)
+        fresh = ik not in self._agg_by_key
+        n_before = len(self.aggs)
+        inner_name = self._make_agg(inner)
+        if fresh and len(self.aggs) == n_before + 1:
+            spec = self.aggs.pop()
+            del self._agg_by_key[ik]
+        else:
+            spec = next(
+                a for a in self.aggs
+                if (a.aggregator.name if isinstance(a, FilteredAggregation)
+                    else a.name) == inner_name)
+        if isinstance(spec, FilteredAggregation):
+            # count(col) lowers to a not-null-filtered count: AND the two
+            base = dataclasses.replace(spec.aggregator, name=name)
+            self.aggs.append(FilteredAggregation(
+                F.and_of(fs, spec.filter), base))
+        else:
+            self.aggs.append(FilteredAggregation(
+                fs, dataclasses.replace(spec, name=name)))
 
     def _agg_output(self, e: Expr) -> str:
         """Projection expr (aggregate or arithmetic over aggregates) ->

@@ -24,7 +24,9 @@ from tpu_olap.ir.expr import (BinOp, Col, Expr, FuncCall, Lit,
                               Subquery, WindowCall)
 
 AGG_FUNCS = {"count", "sum", "min", "max", "avg", "count_distinct",
-             "approx_count_distinct", "theta_sketch"}
+             "approx_count_distinct", "theta_sketch",
+             # agg(...) FILTER (WHERE cond) wrapper node
+             "agg_filter"}
 
 _TOKEN = re.compile(r"""
     \s*(?:
@@ -38,7 +40,7 @@ _KEYWORDS = {
     "select", "from", "where", "group", "by", "having", "order", "limit",
     "offset", "as", "and", "or", "not", "between", "in", "like", "is",
     "null", "asc", "desc", "join", "inner", "left", "on", "distinct",
-    "case", "when", "then", "else", "end", "cast", "union", "all",
+    "case", "when", "then", "else", "end", "cast", "union", "all", "with",
 }
 
 # CAST target type -> internal conversion function (kernels.exprs)
@@ -167,7 +169,25 @@ class _Parser:
 
     def statement_in_parens(self):
         """Like statement() but stops at the enclosing context's
-        terminator (')' or eof) instead of requiring eof."""
+        terminator (')' or eof) instead of requiring eof. An optional
+        WITH prefix defines CTEs, inlined as derived tables (the
+        reference ran CTEs through full Spark SQL; here every reference
+        in FROM position becomes the equivalent subquery)."""
+        ctes = {}
+        if self.at_kw("with"):
+            self.take()
+            while True:
+                name = self.take("name")
+                self.take_kw("as")
+                self.take("op", "(")
+                body = self.statement_in_parens()
+                # later CTEs may reference earlier ones (standard SQL)
+                ctes[name] = _inline_ctes(body, ctes) if ctes else body
+                self.take("op", ")")
+                if self.peek() == ("op", ","):
+                    self.take()
+                    continue
+                break
         parts = [self.select()]
         all_flags = []
         while self.at_kw("union"):
@@ -179,7 +199,7 @@ class _Parser:
             all_flags.append(is_all)
             parts.append(self.select())
         if len(parts) == 1:
-            return parts[0]
+            return _inline_ctes(parts[0], ctes) if ctes else parts[0]
         if len(set(all_flags)) > 1:
             raise SqlError("mixed UNION and UNION ALL are not supported")
         last = parts[-1]
@@ -191,7 +211,7 @@ class _Parser:
                 raise SqlError(
                     "ORDER BY / LIMIT inside a UNION branch is not "
                     "supported (write it after the last branch)")
-        return u
+        return _inline_ctes(u, ctes) if ctes else u
 
     def select(self) -> SelectStmt:
         self.take_kw("select")
@@ -271,6 +291,12 @@ class _Parser:
         if self.at_kw("offset"):
             self.take()
             stmt.offset = int(self.take("num"))
+        # standard SQL: a bare integer in GROUP BY / ORDER BY is a
+        # 1-based projection ordinal, never a constant (sorting by a
+        # constant would silently return unordered results)
+        stmt.group_by = [_resolve_ordinal(e, stmt) for e in stmt.group_by]
+        for oi in stmt.order_by:
+            oi.expr = _resolve_ordinal(oi.expr, stmt)
         # end-of-input is checked by statement(): a select may also end
         # at ')' (subquery/derived table) or UNION
         return stmt
@@ -440,7 +466,21 @@ class _Parser:
                 k2, v2 = self.peek()
                 if k2 == "name" and v2.lower() == "over":
                     return self._window(fname, tuple(args))
-                return FuncCall(fname, tuple(args))
+                call = FuncCall(fname, tuple(args))
+                k2, v2 = self.peek()
+                if k2 == "name" and v2.lower() == "filter":
+                    # standard SQL: agg(...) FILTER (WHERE cond)
+                    if fname not in AGG_FUNCS:
+                        raise SqlError(
+                            f"FILTER only follows an aggregate, not "
+                            f"{fname!r}")
+                    self.take()
+                    self.take("op", "(")
+                    self.take_kw("where")
+                    cond = self.expr()
+                    self.take("op", ")")
+                    return FuncCall("agg_filter", (call, cond))
+                return call
             return Col(v)
         if (k, v) == ("op", "("):
             self.take()
@@ -517,6 +557,77 @@ class _Parser:
         for cond, val in reversed(branches):
             e = FuncCall("if", (cond, val, e))
         return e
+
+
+def _resolve_ordinal(e, stmt):
+    """GROUP BY 2 / ORDER BY 2 -> the 2nd projection's expression."""
+    if not (isinstance(e, Lit) and type(e.value) is int):
+        return e
+    n = e.value
+    if any(isinstance(p, Col) and p.name == "*"
+           for p, _ in stmt.projections):
+        # positions are unknowable before schema expansion; erroring
+        # beats silently sorting by the constant
+        raise SqlError(
+            f"ordinal {n} cannot be resolved with SELECT * — name the "
+            "column instead")
+    if not 1 <= n <= len(stmt.projections):
+        raise SqlError(
+            f"ordinal {n} out of range (select list has "
+            f"{len(stmt.projections)} items)")
+    return stmt.projections[n - 1][0]
+
+
+def _inline_ctes(stmt, ctes: dict):
+    """Replace FROM-position references to WITH-defined names with the
+    equivalent derived table (deep-copied: one CTE may be referenced
+    from several places and later passes mutate statements in place)."""
+    import copy
+
+    def walk_stmt(s):
+        if isinstance(s, UnionStmt):
+            for p in s.parts:
+                walk_stmt(p)
+            return s
+        if s.derived is not None:
+            walk_stmt(s.derived)
+        elif s.table in ctes:
+            s.derived = copy.deepcopy(ctes[s.table])
+        for j in s.joins:
+            if j.table in ctes:
+                raise SqlError(
+                    f"CTE {j.table!r} referenced in a JOIN is not "
+                    "supported (inline it as the FROM table or a "
+                    "subquery)")
+            walk_expr(j.on)  # subqueries inside ON may reference CTEs
+        for e, _ in s.projections:
+            walk_expr(e)
+        walk_expr(s.where)
+        walk_expr(s.having)
+        for e in s.group_by:
+            walk_expr(e)
+        for oi in s.order_by:
+            walk_expr(oi.expr)
+        return s
+
+    def walk_expr(e):
+        if e is None:
+            return
+        if isinstance(e, Subquery):
+            walk_stmt(e.stmt)
+        elif isinstance(e, BinOp):
+            walk_expr(e.left)
+            walk_expr(e.right)
+        elif isinstance(e, (FuncCall, WindowCall)):
+            for a in e.args:
+                walk_expr(a)
+            if isinstance(e, WindowCall):
+                for p in e.partition_by:
+                    walk_expr(p)
+                for ex, _ in e.order_by:
+                    walk_expr(ex)
+
+    return walk_stmt(stmt)
 
 
 def parse_sql(sql: str):
