@@ -118,11 +118,12 @@ def test_merge_propagates_local_overflow():
     assert int(merged["_count"]) == 65  # NOT 64: retry must fire
 
 
-def test_sparse_theta_falls_back():
+def test_sparse_theta_rewrites():
+    """Round 3: theta over a sparse group space executes on the device
+    path (it used to be an UnsupportedAggregation fallback)."""
     eng = _engine()
     eng.sql("SELECT a, b, theta_sketch(c) AS d FROM t GROUP BY a, b")
-    assert not eng.last_plan.rewritten or \
-        "theta" in (eng.last_plan.fallback_reason or "")
+    assert eng.last_plan.rewritten, eng.last_plan.fallback_reason
 
 
 # --------------------------------------------------------------------------
@@ -254,3 +255,35 @@ def test_exchange_skewed_overflow_falls_back_cleanly():
     a = got.sort_values("k").reset_index(drop=True)
     b = expect.sort_values("k").reset_index(drop=True)
     pd.testing.assert_frame_equal(a, b, check_dtype=False)
+
+
+def test_sparse_theta_parity():
+    """theta_sketch over a sparse group space (round-3: previously an
+    UnsupportedAggregation). Per-group distinct counts here stay under
+    the clamped sketch width, so estimates are EXACT and the pandas
+    fallback (exact nunique) is a zero-tolerance oracle."""
+    eng = _engine()
+    plan = eng.planner.plan(
+        "SELECT a, b, theta_sketch(v) AS d FROM t GROUP BY a, b")
+    phys = lower(plan.query, plan.entry.segments, eng.config)
+    assert phys.sparse
+    tk = [p.theta_k for p in phys.agg_plans if p.kind == "theta"]
+    assert tk == [eng.config.sparse_theta_k_cap]
+    check_query(eng,
+                "SELECT a, b, theta_sketch(v) AS d, count(*) AS n FROM t "
+                "GROUP BY a, b")
+
+
+def test_sparse_theta_multichip_exchange():
+    """theta tables ride the hash-exchange all_to_all merge: each owner
+    unions the per-chip [cap, k] rows for its keys."""
+    eng = _engine(num_shards=8, sparse_merge="exchange")
+    check_query(eng,
+                "SELECT a, theta_sketch(b) AS db, count(*) AS n FROM t "
+                "GROUP BY a")
+
+
+def test_sparse_theta_multichip_gather():
+    eng = _engine(num_shards=8, sparse_merge="gather")
+    check_query(eng,
+                "SELECT a, theta_sketch(b) AS db FROM t GROUP BY a")

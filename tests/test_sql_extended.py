@@ -346,11 +346,9 @@ def test_cte_referenced_twice():
 
 
 def test_cte_in_join_rejected_clearly():
-    import pytest as _pytest
-
     from tpu_olap.planner.sqlparse import SqlError
     eng, _ = _engine()
-    with _pytest.raises(SqlError, match="CTE 'x' referenced in a JOIN"):
+    with pytest.raises(SqlError, match="CTE 'x' referenced in a JOIN"):
         eng.sql("WITH x AS (SELECT g FROM t) "
                 "SELECT t.g FROM t JOIN x ON t.g = x.g")
 
@@ -372,13 +370,11 @@ def test_order_by_ordinal():
 
 
 def test_ordinal_out_of_range():
-    import pytest as _pytest
-
     from tpu_olap.planner.sqlparse import SqlError
     eng, _ = _engine()
-    with _pytest.raises(SqlError, match="ordinal 7 out of range"):
+    with pytest.raises(SqlError, match="ordinal 7 out of range"):
         eng.sql("SELECT g FROM t ORDER BY 7")
-    with _pytest.raises(SqlError, match="cannot be resolved with SELECT"):
+    with pytest.raises(SqlError, match="cannot be resolved with SELECT"):
         eng.sql("SELECT * FROM t ORDER BY 1")
 
 
@@ -461,3 +457,19 @@ def test_filter_after_non_aggregate_rejected():
     eng, _ = _engine()
     with pytest.raises(SqlError, match="FILTER only follows an aggregate"):
         eng.sql("SELECT substr(g, 1, 1) FILTER (WHERE v > 0) AS x FROM t")
+
+
+def test_agg_filter_avg_empty_group_is_null():
+    """avg(...) FILTER matching NO rows in a group is NULL on BOTH paths
+    (SQL semantics; the device lowers to a true-division "quotient"
+    post-agg instead of the x/0 -> 0 arithmetic rule)."""
+    from tpu_olap.planner.fallback import execute_fallback
+    eng, df = _engine()
+    sql = ("SELECT g, avg(v) FILTER (WHERE v < -1) AS a FROM t "
+           "GROUP BY g ORDER BY g")
+    dev = eng.sql(sql)
+    assert eng.last_plan.rewritten
+    assert dev["a"].isna().all()
+    fb = execute_fallback(eng.planner.plan(sql).stmt, eng.catalog,
+                          eng.config)
+    assert fb["a"].isna().all()

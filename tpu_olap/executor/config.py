@@ -38,6 +38,11 @@ class EngineConfig:
     # PRESENT groups before the query is declared non-rewritable.
     sparse_group_cap: int = 1 << 15
     sparse_group_budget: int = 1 << 21
+    # theta sketch width on the SPARSE path: [cap, k] tables (and their
+    # [cap, parts*k] merge transients) must stay HBM-modest, so k is
+    # clamped below the dense-path theta_k_cap. 256 -> ~6% RSE, the
+    # sketch-shrink-under-memory-pressure tradeoff Druid also makes.
+    sparse_theta_k_cap: int = 256
     # multi-chip sparse merge strategy: "exchange" = hash-partitioned
     # all_to_all (present groups scale with chip count: capacity is
     # D x sparse_group_budget when keys distribute), "gather" = legacy

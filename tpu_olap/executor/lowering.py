@@ -300,10 +300,14 @@ def _lower_agg(query, table, config) -> PhysicalPlan:
         if not config.enable_x64:
             raise UnsupportedAggregation(
                 "sparse group-by needs int64 keys (enable_x64=False)")
-        for p in agg_plans:
-            if p.kind == "theta":
-                raise UnsupportedAggregation(
-                    "theta sketch over a sparse group space")
+        # theta rides the sparse path with a clamped sketch width (the
+        # [cap, k] table and its merge transients are per-group state;
+        # see EngineConfig.sparse_theta_k_cap)
+        import dataclasses as _dc
+        agg_plans = tuple(
+            _dc.replace(p, theta_k=min(p.theta_k,
+                                       config.sparse_theta_k_cap))
+            if p.kind == "theta" else p for p in agg_plans)
     if not sparse and not config.enable_x64:
         # sketch state is [groups × radix]; without 64-bit lanes the flat
         # scatter index must fit int32

@@ -178,7 +178,14 @@ def _eval_pa(pa, arrays):
         vals = [_eval_pa(f, arrays) for f in pa.fields]
         out = np.asarray(vals[0], np.float64)
         for v in vals[1:]:
-            if pa.fn in ("/", "quotient"):
+            if pa.fn == "quotient":
+                # true floating division (Druid's "quotient"): zero
+                # denominator -> NaN, rendered as SQL NULL — used by
+                # filtered AVG so an empty filtered group is NULL, not 0
+                with np.errstate(divide="ignore", invalid="ignore"):
+                    out = np.where(v != 0, out / np.where(v != 0, v, 1),
+                                   np.nan)
+            elif pa.fn == "/":
                 # Druid arithmetic division yields 0 on division by zero
                 with np.errstate(divide="ignore", invalid="ignore"):
                     out = np.where(v != 0, out / np.where(v != 0, v, 1), 0.0)

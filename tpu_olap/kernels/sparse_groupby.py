@@ -30,6 +30,7 @@ from __future__ import annotations
 import numpy as np
 
 from tpu_olap.kernels import hll as hll_mod
+from tpu_olap.kernels import theta as theta_mod
 from tpu_olap.kernels.groupby import (UnsupportedAggregation, _hash_fields,
                                       _ident)
 
@@ -121,11 +122,11 @@ def sparse_group_reduce(key, mask, env, plans, cap, consts, xp):
                 carry(f"v:{p.name}",
                       xp.where(mm, x.astype(p.acc_dtype), ident))
                 carry(f"nn:{p.name}", mm)
-        elif p.kind == "hll":
+        elif p.kind in ("hll", "theta"):
             h, valid = _hash_fields(env, p, m, xp, consts)
             carry(f"h:{p.name}", h)
             carry(f"hv:{p.name}", valid)
-        else:  # theta is dense/fallback-only (phase 1)
+        else:
             raise UnsupportedAggregation(
                 f"sparse group-by does not support {p.kind!r}")
 
@@ -168,6 +169,16 @@ def sparse_group_reduce(key, mask, env, plans, cap, consts, xp):
             regs = hll_mod.hll_update(h, valid, xp.where(valid, gid, 0),
                                       cap + 1, xp)
             out[p.name] = regs[:cap]
+            continue
+        if p.kind == "theta":
+            h = sorted_ops[slots[f"h:{p.name}"]]
+            valid = sorted_ops[slots[f"hv:{p.name}"]]
+            # theta_update routes invalid rows to the num_groups pad row
+            # itself; gid==cap (overflow/sentinel) rows land in the pad
+            # row and are sliced off
+            t = theta_mod.theta_update(h, valid, gid, cap + 1,
+                                       p.theta_k, xp)
+            out[p.name] = t[:cap]
             continue
     return out
 
@@ -216,6 +227,44 @@ def merge_sparse(parts: list, plans, cap, xp):
             out[f"_nn_{p.name}"] = seg_sum(gathered(f"_nn_{p.name}"))
         elif p.kind == "hll":
             out[p.name] = seg_ext(gathered(p.name), "max")
+        elif p.kind == "theta":
+            out[p.name] = _seg_theta_union(gathered(p.name), gid, cap,
+                                           len(parts), xp)
         else:
             raise UnsupportedAggregation(p.kind)
     return out
+
+
+def _seg_theta_union(rows, gid, cap, n_parts, xp):
+    """Segmented theta union: [n, k] row-sorted tables with group ids
+    `gid` (sorted; cap = dropped pad slot) -> [cap, k] merged tables of
+    the k smallest distinct per group. Each part contributes at most one
+    row per key, so within-group rank < n_parts; rows rank-scatter into
+    a [cap, n_parts*k] wide buffer which sorts, dedupes, and truncates.
+    Transient memory is cap * n_parts * k * 8B — sparse_theta_k_cap
+    keeps that modest."""
+    import jax
+
+    n, k = rows.shape
+    idx = xp.arange(n, dtype=xp.int32)
+    boundary = xp.concatenate([xp.ones((1,), bool), gid[1:] != gid[:-1]])
+    starts = xp.where(boundary, idx, 0)
+    if xp is np:
+        seg_start = np.maximum.accumulate(starts)
+    else:
+        seg_start = jax.lax.cummax(starts)
+    rank = xp.minimum(idx - seg_start, n_parts - 1)
+    slot = gid.astype(xp.int64) * n_parts + rank
+    shape = ((cap + 1) * n_parts, k)
+    if xp is np:
+        buf = np.full(shape, theta_mod.EMPTY, rows.dtype)
+        buf[slot] = rows
+    else:
+        buf = xp.full(shape, theta_mod.EMPTY, rows.dtype) \
+            .at[slot].set(rows, mode="drop")
+    wide = buf[:cap * n_parts].reshape(cap, n_parts * k)
+    wide = xp.sort(wide, axis=-1)
+    dup = xp.concatenate(
+        [xp.zeros((cap, 1), bool), wide[:, 1:] == wide[:, :-1]], axis=-1)
+    wide = xp.sort(xp.where(dup, theta_mod.EMPTY, wide), axis=-1)
+    return wide[:, :k]

@@ -690,8 +690,12 @@ class _Rewriter:
             self.aggs.append(FilteredAggregation(
                 fs, SumAggregation(s, fieldn, vt)))
             self.aggs.append(FilteredAggregation(fs, CountAggregation(c)))
+            # "quotient" (true division): a group with NO filter-matching
+            # rows divides 0 by 0 and must render NULL per SQL AVG
+            # semantics — the "/" post-agg's x/0 -> 0 rule would say 0
             self.postaggs.append(ArithmeticPostAgg(
-                name, "/", (FieldAccessPostAgg(s), FieldAccessPostAgg(c))))
+                name, "quotient",
+                (FieldAccessPostAgg(s), FieldAccessPostAgg(c))))
             return
         # build the inner spec through the normal path, then re-own it:
         # pop it if newly created (and forget its dedup entry so a later
