@@ -473,3 +473,58 @@ def test_agg_filter_avg_empty_group_is_null():
     fb = execute_fallback(eng.planner.plan(sql).stmt, eng.catalog,
                           eng.config)
     assert fb["a"].isna().all()
+
+
+def test_intersect():
+    eng, df = _engine()
+    got = eng.sql("SELECT g FROM t WHERE v > 400 "
+                  "INTERSECT SELECT g FROM t WHERE v < 100 ORDER BY g")
+    hi = set(df[df.v > 400].g)
+    lo = set(df[df.v < 100].g)
+    assert got["g"].tolist() == sorted(hi & lo)
+    assert "INTERSECT" in eng.last_plan.fallback_reason
+
+
+def test_except():
+    eng, df = _engine()
+    got = eng.sql("SELECT city FROM t EXCEPT SELECT city FROM t "
+                  "WHERE g = 'a' ORDER BY city")
+    allc = set(df.city)
+    witha = set(df[df.g == "a"].city)
+    assert got["city"].tolist() == sorted(allc - witha)
+
+
+def test_mixed_set_operators_need_parens():
+    from tpu_olap.planner.sqlparse import SqlError
+    eng, _ = _engine()
+    with pytest.raises(SqlError, match="mixed set operators"):
+        eng.sql("SELECT g FROM t UNION SELECT g FROM t "
+                "INTERSECT SELECT g FROM t")
+
+
+def test_exists_subquery():
+    eng, df = _engine()
+    got = eng.sql("SELECT count(*) AS n FROM t "
+                  "WHERE EXISTS (SELECT v FROM t WHERE v > 490)")
+    assert got["n"][0] == (len(df) if (df.v > 490).any() else 0)
+    got = eng.sql("SELECT count(*) AS n FROM t "
+                  "WHERE NOT EXISTS (SELECT v FROM t WHERE v > 9999)")
+    assert got["n"][0] == len(df)
+    got = eng.sql("SELECT count(*) AS n FROM t "
+                  "WHERE EXISTS (SELECT v FROM t WHERE v > 9999)")
+    assert got["n"][0] == 0
+
+
+def test_correlated_subquery_rejected_clearly():
+    """A correlated reference must error legibly, never silently resolve
+    against the inner frame (qualifier stripping would otherwise turn
+    `b.x = a.x` into `b.x = b.x` = always true)."""
+    from tpu_olap.planner.fallback import FallbackError
+    eng, _ = _engine()
+    eng.register_table("u", pd.DataFrame({"g": ["zz"]}), accelerate=False)
+    with pytest.raises(FallbackError, match="correlated subquery"):
+        eng.sql("SELECT count(*) AS n FROM t "
+                "WHERE EXISTS (SELECT 1 FROM u WHERE u.g = t.g)")
+    with pytest.raises(FallbackError, match="correlated subquery"):
+        eng.sql("SELECT count(*) AS n FROM t "
+                "WHERE v > (SELECT max(v) FROM u WHERE u.g = t.g)")

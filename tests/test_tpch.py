@@ -1,0 +1,219 @@
+"""TPC-H-flavored suite over a denormalized orderLineItemPartSupplier
+fact — the direct analog of the reference's test backbone (SURVEY.md §5:
+a TPC-H denormalized fact registered once plain and once accelerated,
+each query asserting WHICH path serves it and that results agree).
+
+Queries are the BI-shaped adaptations of the classic set: aggregates,
+star joins through declared FDs, date filters, and HAVING/topN — plus
+shapes the rewrite rules must decline (row-vs-row comparisons,
+correlated-ish predicates rewritten as joins) that the fallback must
+still answer ("correct-but-slow, never an error", SURVEY.md §2).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpu_olap import Engine
+from tpu_olap.bench.parity import assert_frame_parity
+from tpu_olap.executor import EngineConfig
+from tpu_olap.planner.fallback import execute_fallback
+
+_NATIONS = {
+    "FRANCE": "EUROPE", "GERMANY": "EUROPE", "RUSSIA": "EUROPE",
+    "BRAZIL": "AMERICA", "CANADA": "AMERICA", "PERU": "AMERICA",
+    "CHINA": "ASIA", "INDIA": "ASIA", "JAPAN": "ASIA",
+}
+
+
+def _olps(n=12_000, seed=29):
+    """orderLineItemPartSupplier: one flat frame, TPC-H column names."""
+    rng = np.random.default_rng(seed)
+    nations = np.array(list(_NATIONS))
+    df = pd.DataFrame({
+        "o_orderdate": pd.to_datetime("1995-01-01")
+        + pd.to_timedelta(rng.integers(0, 730, n), unit="D"),
+        "l_quantity": rng.integers(1, 51, n).astype(np.int64),
+        "l_extendedprice": rng.integers(900, 105_000, n).astype(np.int64),
+        "l_discount": rng.integers(0, 11, n).astype(np.int64),  # percent
+        "l_returnflag": rng.choice(["A", "N", "R"], n),
+        "l_linestatus": rng.choice(["F", "O"], n),
+        "l_shipmode": rng.choice(["AIR", "RAIL", "SHIP", "TRUCK"], n),
+        "p_brand": rng.choice([f"Brand#{i}" for i in range(10, 55)], n),
+        "p_type": rng.choice(
+            [f"{a} {b}" for a in ("ECONOMY", "STANDARD", "PROMO")
+             for b in ("BRASS", "COPPER", "STEEL")], n),
+        "p_size": rng.integers(1, 51, n).astype(np.int64),
+        "s_nation": rng.choice(nations, n),
+        "c_nation": rng.choice(nations, n),
+        "c_mktsegment": rng.choice(
+            ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD",
+             "MACHINERY"], n),
+        "o_orderpriority": rng.choice(
+            ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-LOW", "5-NOT"], n),
+    })
+    df["s_region"] = df.s_nation.map(_NATIONS)
+    df["c_region"] = df.c_nation.map(_NATIONS)
+    return df
+
+
+def _nation_dim():
+    return pd.DataFrame({"n_name": list(_NATIONS),
+                         "n_region": list(_NATIONS.values())})
+
+
+@pytest.fixture(scope="module")
+def eng():
+    from tpu_olap.catalog.star import StarDimension, StarSchema
+    e = Engine(EngineConfig())
+    df = _olps()
+    star = StarSchema(
+        fact="olps",
+        dimensions=(
+            StarDimension("s_nat", fact_key="s_nation", dim_key="n_name",
+                          column_map={"n_name": "s_nation",
+                                      "n_region": "s_region"}),
+            StarDimension("c_nat", fact_key="c_nation", dim_key="n_name",
+                          column_map={"n_name": "c_nation",
+                                      "n_region": "c_region"}),
+        ))
+    e.register_table("olps", df, time_column="o_orderdate",
+                     star_schema=star, block_rows=2048)
+    e.register_table("s_nat", _nation_dim(), accelerate=False)
+    e.register_table("c_nat", _nation_dim(), accelerate=False)
+    return e
+
+
+def _check(eng, sql, expect_rewrite, approx_cols=()):
+    dev = eng.sql(sql)
+    assert eng.last_plan.rewritten == expect_rewrite, \
+        (eng.last_plan.fallback_reason, sql)
+    ref = execute_fallback(eng.planner.plan(sql).stmt, eng.catalog,
+                           eng.config)
+    assert_frame_parity(dev, ref, approx_cols=approx_cols)
+    return dev
+
+
+def test_q1_pricing_summary(eng):
+    """Q1 shape: multi-agg pricing summary with a date ceiling."""
+    _check(eng, """
+        SELECT l_returnflag, l_linestatus,
+               sum(l_quantity) AS sum_qty,
+               sum(l_extendedprice) AS sum_base,
+               sum(l_extendedprice * (100 - l_discount)) AS sum_disc,
+               avg(l_quantity) AS avg_qty,
+               count(*) AS count_order
+        FROM olps
+        WHERE o_orderdate < '1996-09-01'
+        GROUP BY l_returnflag, l_linestatus
+        ORDER BY l_returnflag, l_linestatus""", True)
+
+
+def test_q3_segment_revenue_topn(eng):
+    """Q3 shape: revenue by order attribute for one market segment,
+    ordered LIMIT (TopN eligible)."""
+    _check(eng, """
+        SELECT o_orderpriority,
+               sum(l_extendedprice * (100 - l_discount)) AS revenue
+        FROM olps
+        WHERE c_mktsegment = 'BUILDING'
+          AND o_orderdate < '1995-06-30'
+        GROUP BY o_orderpriority
+        ORDER BY revenue DESC LIMIT 10""", True)
+
+
+def test_q5_local_supplier_volume_star(eng):
+    """Q5 shape: region-filtered volume grouped by supplier nation,
+    reaching region through the declared star join."""
+    _check(eng, """
+        SELECT s_nation, sum(l_extendedprice) AS revenue
+        FROM olps JOIN s_nat ON s_nation = n_name
+        WHERE n_region = 'ASIA'
+          AND o_orderdate >= '1995-01-01' AND o_orderdate < '1996-01-01'
+        GROUP BY s_nation ORDER BY revenue DESC""", True)
+
+
+def test_q5_row_comparison_falls_back(eng):
+    """True Q5 requires c_nation = s_nation (row-vs-row), outside the
+    dimension/filter algebra — must still answer via the fallback."""
+    _check(eng, """
+        SELECT s_nation, sum(l_extendedprice) AS revenue
+        FROM olps WHERE c_nation = s_nation
+        GROUP BY s_nation ORDER BY s_nation""", False)
+
+
+def test_q6_forecast_revenue(eng):
+    """Q6 = the SSB Q1 shape: global filtered sum of a product."""
+    _check(eng, """
+        SELECT sum(l_extendedprice * l_discount) AS revenue
+        FROM olps
+        WHERE o_orderdate >= '1995-01-01' AND o_orderdate < '1996-01-01'
+          AND l_discount BETWEEN 3 AND 5 AND l_quantity < 24""", True)
+
+
+def test_q12_shipmode_priority_counts(eng):
+    """Q12 shape: counts split by a CASE over priority, per ship mode."""
+    _check(eng, """
+        SELECT l_shipmode,
+               sum(CASE WHEN o_orderpriority = '1-URGENT'
+                        THEN 1 ELSE 0 END) AS high_line_count,
+               count(*) AS n
+        FROM olps
+        WHERE l_shipmode IN ('SHIP', 'RAIL')
+        GROUP BY l_shipmode ORDER BY l_shipmode""", True)
+
+
+def test_q14_promo_revenue_filtered_agg(eng):
+    """Q14 shape: promo share via FILTER (the modern spelling of the
+    CASE ratio)."""
+    _check(eng, """
+        SELECT sum(l_extendedprice) FILTER (WHERE p_type LIKE 'PROMO%')
+                   AS promo,
+               sum(l_extendedprice) AS total
+        FROM olps
+        WHERE o_orderdate >= '1995-09-01'
+          AND o_orderdate < '1995-10-01'""", True)
+
+
+def test_q16_brand_distinct_suppliers(eng):
+    """Q16 shape: approximate distinct per brand with exclusions."""
+    _check(eng, """
+        SELECT p_brand, approx_count_distinct(s_nation) AS supplier_cnt
+        FROM olps
+        WHERE NOT (p_type LIKE 'ECONOMY%') AND p_size IN (1, 4, 9, 14)
+        GROUP BY p_brand ORDER BY p_brand""", True,
+           approx_cols=("supplier_cnt",))
+
+
+def test_q19_disjunctive_filter(eng):
+    """Q19 shape: OR of bracketed conjunction groups."""
+    _check(eng, """
+        SELECT sum(l_extendedprice * (100 - l_discount)) AS revenue
+        FROM olps
+        WHERE (p_brand = 'Brand#12' AND l_quantity BETWEEN 1 AND 11)
+           OR (p_brand = 'Brand#23' AND l_quantity BETWEEN 10 AND 20)
+           OR (p_brand = 'Brand#34' AND l_quantity BETWEEN 20 AND 30)""",
+           True)
+
+
+def test_q22_cte_over_aggregate(eng):
+    """Q22 shape: a CTE aggregate consumed by an outer filter — executes
+    through the derived-table fallback."""
+    _check(eng, """
+        WITH nation_rev AS (
+            SELECT c_nation, sum(l_extendedprice) AS rev, count(*) AS n
+            FROM olps GROUP BY c_nation)
+        SELECT c_nation, rev FROM nation_rev
+        WHERE rev > (SELECT avg(rev) FROM nation_rev)
+        ORDER BY c_nation""", False)
+
+
+def test_monthly_timeseries(eng):
+    """Granularity bucketing over the order date (the reference's
+    date-function suites)."""
+    _check(eng, """
+        SELECT date_trunc('month', o_orderdate) AS m,
+               sum(l_extendedprice) AS rev
+        FROM olps
+        WHERE o_orderdate >= '1995-01-01' AND o_orderdate < '1995-07-01'
+        GROUP BY date_trunc('month', o_orderdate) ORDER BY m""", True)

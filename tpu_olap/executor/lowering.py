@@ -287,8 +287,7 @@ def _lower_agg(query, table, config) -> PhysicalPlan:
     sparse = total > config.dense_group_budget
     if sparse:
         # sort-based sparse path (SURVEY.md §8.4 #1): GroupBy only (the
-        # timeseries/topN assemblers index the dense bucket space), no
-        # theta (its [cap, k] tables don't re-merge cheaply in phase 1)
+        # timeseries/topN assemblers index the dense bucket space)
         if not isinstance(query, GroupByQuerySpec):
             raise UnsupportedAggregation(
                 f"group space {total} exceeds dense budget "

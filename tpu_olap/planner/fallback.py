@@ -106,12 +106,22 @@ def _execute_union(stmt: UnionStmt, catalog, config) -> pd.DataFrame:
     for f in frames[1:]:
         if len(f.columns) != len(cols):
             raise FallbackError(
-                f"UNION branches have {len(cols)} vs {len(f.columns)} "
-                "columns")
-    out = pd.concat([f.set_axis(cols, axis=1) for f in frames],
-                    ignore_index=True)
-    if not stmt.all:
-        out = out.drop_duplicates(ignore_index=True)
+                f"{stmt.op.upper()} branches have {len(cols)} vs "
+                f"{len(f.columns)} columns")
+    frames = [f.set_axis(cols, axis=1) for f in frames]
+    if stmt.op == "union":
+        out = pd.concat(frames, ignore_index=True)
+        if not stmt.all:
+            out = out.drop_duplicates(ignore_index=True)
+    else:
+        # INTERSECT / EXCEPT: set semantics (dedup first, like SQL)
+        out = frames[0].drop_duplicates(ignore_index=True)
+        for f in frames[1:]:
+            keep = pd.MultiIndex.from_frame(out).isin(
+                pd.MultiIndex.from_frame(f.drop_duplicates()))
+            if stmt.op == "except":
+                keep = ~keep
+            out = out[keep].reset_index(drop=True)
     if stmt.order_by:
         keys, ascending = [], []
         for item in stmt.order_by:
@@ -126,6 +136,65 @@ def _execute_union(stmt: UnionStmt, catalog, config) -> pd.DataFrame:
     lo = stmt.offset
     hi = None if stmt.limit is None else lo + stmt.limit
     return out.iloc[lo:hi].reset_index(drop=True)
+
+
+def _check_uncorrelated(stmt):
+    """Reject correlated subqueries LEGIBLY: a qualified column whose
+    table prefix is not in the subquery's own FROM/JOIN scope references
+    the outer query. Without this check the evaluator's qualifier
+    stripping (name.split('.')[-1]) would silently resolve `outer.x`
+    against the INNER frame and return wrong rows."""
+    def scope_tables(s):
+        if isinstance(s, UnionStmt):
+            out = set()
+            for p in s.parts:
+                out |= scope_tables(p)
+            return out
+        tables = {s.table}
+        tables |= {j.table for j in s.joins}
+        return tables
+
+    def walk_expr(e, tables):
+        if e is None or isinstance(e, Lit):
+            return
+        if isinstance(e, Col):
+            if "." in e.name:
+                qual = e.name.rsplit(".", 1)[0]
+                if qual not in tables:
+                    raise FallbackError(
+                        f"correlated subquery reference {e.name!r} is "
+                        "not supported (rewrite as a join)")
+            return
+        if isinstance(e, Subquery):
+            return  # nested scope checks itself when resolved
+        if isinstance(e, BinOp):
+            walk_expr(e.left, tables)
+            walk_expr(e.right, tables)
+        elif isinstance(e, (FuncCall, WindowCall)):
+            for a in e.args:
+                walk_expr(a, tables)
+
+    def walk_stmt(s):
+        if isinstance(s, UnionStmt):
+            for p in s.parts:
+                walk_stmt(p)
+            return
+        tables = scope_tables(s)
+        for e, _ in s.projections:
+            walk_expr(e, tables)
+        walk_expr(s.where, tables)
+        walk_expr(s.having, tables)
+        for e in s.group_by:
+            walk_expr(e, tables)
+        for item in s.order_by:
+            walk_expr(item.expr, tables)
+        for j in s.joins:
+            walk_expr(j.on, tables)
+        if s.derived is not None:
+            walk_stmt(s.derived)
+
+    walk_stmt(stmt)
+    return stmt
 
 
 def _scalar_from(sub_df: pd.DataFrame):
@@ -152,14 +221,25 @@ def _resolve_subqueries(stmt: SelectStmt, catalog, config) -> SelectStmt:
         nonlocal hit
         if e is None or isinstance(e, (Lit, Col)):
             return e
+        if isinstance(e, FuncCall) and e.name == "exists":
+            # EXISTS (SELECT ...): true iff the (non-correlated)
+            # subquery returns any row — one row is enough, so cap it
+            hit = True
+            import dataclasses as _dc
+            inner = _check_uncorrelated(e.args[0].stmt)
+            inner = _dc.replace(inner, limit=1, order_by=[])
+            sub = execute_fallback(inner, catalog, config)
+            return Lit(len(sub) > 0)
         if isinstance(e, Subquery):
             hit = True
             return Lit(_scalar_from(
-                execute_fallback(e.stmt, catalog, config)))
+                execute_fallback(_check_uncorrelated(e.stmt), catalog,
+                                 config)))
         if isinstance(e, FuncCall) and e.name == "in_subquery":
             hit = True
             lhs = walk(e.args[0])
-            sub = execute_fallback(e.args[1].stmt, catalog, config)
+            sub = execute_fallback(_check_uncorrelated(e.args[1].stmt),
+                                   catalog, config)
             if sub.shape[1] != 1:
                 raise FallbackError(
                     f"IN subquery returned {sub.shape[1]} columns")
@@ -233,7 +313,12 @@ def _join_and_filter(stmt, df, catalog, time_col):
         pending = still
 
     for c in where_conjs:
-        df = df[_eval_bool(c, df, time_col)]
+        m = _eval_bool(c, df, time_col)
+        if isinstance(m, bool):  # constant predicate, e.g. EXISTS(...)
+            if not m:
+                df = df.iloc[0:0]
+            continue
+        df = df[m]
     return df
 
 

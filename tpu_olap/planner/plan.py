@@ -149,7 +149,8 @@ class DruidPlanner:
             entry = self.catalog.maybe(stmt.table)
             return PlanResult(
                 stmt=stmt, entry=entry, sql=sql,
-                fallback_reason="UNION executes on the fallback path")
+                fallback_reason=f"{stmt.op.upper()} executes on the "
+                                "fallback path")
         if stmt.derived is not None:
             return PlanResult(
                 stmt=stmt, entry=None, sql=sql,
@@ -632,10 +633,14 @@ class _Rewriter:
         elif fn in ("sum", "min", "max"):
             if len(e.args) != 1:
                 raise RewriteError(f"{fn} takes one argument")
-            fieldn, vt = self._agg_field(e.args[0])
-            cls = {"sum": SumAggregation, "min": MinAggregation,
-                   "max": MaxAggregation}[fn]
-            self.aggs.append(cls(name, fieldn, vt))
+            arg = e.args[0]
+            if fn == "sum" and self._case_to_filter(arg, name):
+                pass  # sum(CASE WHEN c THEN x ELSE 0) -> filtered agg
+            else:
+                fieldn, vt = self._agg_field(arg)
+                cls = {"sum": SumAggregation, "min": MinAggregation,
+                       "max": MaxAggregation}[fn]
+                self.aggs.append(cls(name, fieldn, vt))
         elif fn == "count":  # count(col): non-null count
             fieldn, _ = self._agg_field(e.args[0])
             from tpu_olap.ir.aggregations import FilteredAggregation
@@ -673,6 +678,36 @@ class _Rewriter:
             raise RewriteError(f"unknown aggregate {fn!r}")
         self._agg_by_key[k] = name
         return name
+
+    def _case_to_filter(self, arg, name: str) -> bool:
+        """sum(CASE WHEN cond THEN x ELSE 0 END) -> filtered aggregator
+        (Druid's own translation). Lets conditions over STRING columns
+        ride the filter machinery — as a virtual-column expression the
+        string codes would be rejected. Returns True when handled."""
+        from tpu_olap.ir.aggregations import FilteredAggregation
+        if not (isinstance(arg, FuncCall) and arg.name == "if"
+                and len(arg.args) == 3):
+            return False
+        cond, then, other = arg.args
+        # ELSE 0 only: with ELSE NULL an all-non-matching group sums to
+        # SQL NULL, not the filtered aggregator's empty-sum 0
+        if not (isinstance(other, Lit) and other.value == 0
+                and other.value is not False):
+            return False
+        try:
+            fs = self._to_filter(cond)
+        except RewriteError:
+            return False  # condition outside the filter algebra
+        if isinstance(then, Lit) and then.value == 1 \
+                and then.value is not True:
+            self.aggs.append(FilteredAggregation(fs, CountAggregation(name)))
+            return True
+        if isinstance(then, Lit):
+            return False  # sum of a non-unit constant: no direct agg
+        fieldn, vt = self._agg_field(then)
+        self.aggs.append(FilteredAggregation(
+            fs, SumAggregation(name, fieldn, vt)))
+        return True
 
     def _make_filtered_agg(self, e: FuncCall, name: str) -> None:
         import dataclasses

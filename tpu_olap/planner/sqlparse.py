@@ -41,6 +41,7 @@ _KEYWORDS = {
     "offset", "as", "and", "or", "not", "between", "in", "like", "is",
     "null", "asc", "desc", "join", "inner", "left", "on", "distinct",
     "case", "when", "then", "else", "end", "cast", "union", "all", "with",
+    "intersect", "except", "exists",
 }
 
 # CAST target type -> internal conversion function (kernels.exprs)
@@ -119,15 +120,19 @@ class SelectStmt:
 
 @dataclass
 class UnionStmt:
-    """SELECT ... UNION [ALL] SELECT ... — fallback-only (the reference
-    ran these through full Spark SQL; here the pandas interpreter
-    executes each branch and combines). ORDER/LIMIT/OFFSET written after
-    the last branch apply to the whole union, per standard SQL."""
+    """SELECT ... {UNION [ALL] | INTERSECT | EXCEPT} SELECT ... —
+    fallback-only (the reference ran these through full Spark SQL; here
+    the pandas interpreter executes each branch and combines).
+    ORDER/LIMIT/OFFSET written after the last branch apply to the whole
+    compound, per standard SQL. One operator kind per chain — mixing
+    UNION with INTERSECT/EXCEPT needs explicit derived-table parens (no
+    silent precedence surprises)."""
     parts: list                  # [SelectStmt]
     all: bool = False
     order_by: list = field(default_factory=list)
     limit: int | None = None
     offset: int = 0
+    op: str = "union"            # "union" | "intersect" | "except"
 
     @property
     def table(self) -> str:
@@ -190,27 +195,34 @@ class _Parser:
                 break
         parts = [self.select()]
         all_flags = []
-        while self.at_kw("union"):
-            self.take()
+        ops = []
+        while self.at_kw("union", "intersect", "except"):
+            ops.append(self.take())
             is_all = False
             if self.at_kw("all"):
+                if ops[-1] != "union":
+                    raise SqlError(f"{ops[-1].upper()} ALL not supported")
                 self.take()
                 is_all = True
             all_flags.append(is_all)
             parts.append(self.select())
         if len(parts) == 1:
             return _inline_ctes(parts[0], ctes) if ctes else parts[0]
-        if len(set(all_flags)) > 1:
+        if len(set(ops)) > 1:
+            raise SqlError(
+                "mixed set operators in one chain — parenthesize as a "
+                "derived table to make precedence explicit")
+        if ops[0] == "union" and len(set(all_flags)) > 1:
             raise SqlError("mixed UNION and UNION ALL are not supported")
         last = parts[-1]
         u = UnionStmt(parts, all=all_flags[0], order_by=last.order_by,
-                      limit=last.limit, offset=last.offset)
+                      limit=last.limit, offset=last.offset, op=ops[0])
         last.order_by, last.limit, last.offset = [], None, 0
         for p in parts[:-1]:
             if p.order_by or p.limit is not None or p.offset:
                 raise SqlError(
-                    "ORDER BY / LIMIT inside a UNION branch is not "
-                    "supported (write it after the last branch)")
+                    "ORDER BY / LIMIT inside a set-operator branch is "
+                    "not supported (write it after the last branch)")
         return _inline_ctes(u, ctes) if ctes else u
 
     def select(self) -> SelectStmt:
@@ -430,6 +442,14 @@ class _Parser:
             return Lit(None)
         if k == "kw" and v == "case":
             return self._case()
+        if k == "kw" and v == "exists":
+            # EXISTS (SELECT ...) -> true iff the subquery has rows;
+            # non-correlated only (resolved by the fallback interpreter)
+            self.take()
+            self.take("op", "(")
+            sub = self.statement_in_parens()
+            self.take("op", ")")
+            return FuncCall("exists", (Subquery(sub),))
         if k == "kw" and v == "cast":
             self.take()
             self.take("op", "(")

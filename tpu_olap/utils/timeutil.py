@@ -73,8 +73,12 @@ def period_millis(period: str) -> int:
 
 
 def parse_iso_datetime(s: str) -> int:
-    """ISO-8601 datetime (or date) string -> epoch millis (UTC)."""
+    """ISO-8601 datetime (or date) string -> epoch millis (UTC). Also
+    accepts the millis_to_iso eternity spellings for exact round-trips
+    of open interval endpoints."""
     s = s.strip()
+    if s.startswith(("-eternity(", "+eternity(")) and s.endswith(")"):
+        return int(s[s.index("(") + 1:-1])
     if s.endswith("Z"):
         s = s[:-1] + "+00:00"
     d = _dt.datetime.fromisoformat(s)
@@ -83,7 +87,18 @@ def parse_iso_datetime(s: str) -> int:
     return int(d.timestamp() * 1000)
 
 
+# datetime can only render years 1..9999; open interval endpoints carry
+# eternity-scale sentinels (ir.interval.ETERNITY = ±2^62 ms) that must
+# still serialize stably (plan fingerprints, Druid-wire output)
+_MIN_RENDER_MS = -62135596800000   # 0001-01-01T00:00:00Z
+_MAX_RENDER_MS = 253402300799999   # 9999-12-31T23:59:59.999Z
+
+
 def millis_to_iso(ms: int) -> str:
+    if ms < _MIN_RENDER_MS:
+        return f"-eternity({ms})"
+    if ms > _MAX_RENDER_MS:
+        return f"+eternity({ms})"
     d = _dt.datetime.fromtimestamp(ms / 1000.0, tz=UTC)
     return d.strftime("%Y-%m-%dT%H:%M:%S.") + f"{ms % 1000:03d}Z"
 
