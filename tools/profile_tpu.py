@@ -80,9 +80,60 @@ def main():
         }
         print(f"[profile] {qname}: {prof[qname]}", file=sys.stderr)
 
+    # non-aggregate / auxiliary paths: the SSB 13 are all aggregates, so
+    # exercise scan, paged select, search, raw-IR passthrough, and theta
+    # set ops on the live backend too (smoke + timing, oracle-light)
+    aux = {}
+
+    def run_aux(name, fn):
+        # failures must not discard the already-collected 13-query
+        # profile (these raw-IR paths bypass Engine.sql's structural
+        # fallback, and tunnel time is too scarce to lose the run)
+        try:
+            fn()  # warm
+            t0 = time.perf_counter()
+            r = fn()
+            aux[name] = {
+                "wall_ms": round((time.perf_counter() - t0) * 1000, 2),
+                "rows": len(r) if hasattr(r, "__len__") else None}
+        except Exception as e:  # noqa: BLE001 — recorded, not fatal
+            aux[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        print(f"[profile] aux {name}: {aux[name]}", file=sys.stderr)
+
+    run_aux("scan_limit", lambda: eng.sql(
+        "SELECT lo_orderkey, lo_revenue FROM lineorder "
+        "WHERE lo_discount = 5 LIMIT 100"))
+    run_aux("select_page", lambda: eng.select_page(
+        "lineorder", columns=("lo_orderkey", "lo_revenue"),
+        page_size=64)[0])
+    run_aux("search", lambda: eng.sql(
+        "SEARCH DRUID DATASOURCE lineorder FOR 'MFGR#12' "
+        "IN p_category LIMIT 10"))
+    spec = json.dumps({
+        "queryType": "timeseries", "granularity": "all",
+        "aggregations": [
+            {"type": "filtered", "name": "ta",
+             "filter": {"type": "selector", "dimension": "lo_discount",
+                        "value": 1},
+             "aggregator": {"type": "thetaSketch", "name": "ta",
+                            "fieldName": "lo_custkey", "size": 4096}},
+            {"type": "filtered", "name": "tb",
+             "filter": {"type": "selector", "dimension": "lo_discount",
+                        "value": 2},
+             "aggregator": {"type": "thetaSketch", "name": "tb",
+                            "fieldName": "lo_custkey", "size": 4096}}],
+        "postAggregations": [{
+            "type": "thetaSketchEstimate", "name": "both",
+            "field": {"type": "thetaSketchSetOp", "func": "INTERSECT",
+                      "fields": [
+                          {"type": "fieldAccess", "fieldName": "ta"},
+                          {"type": "fieldAccess", "fieldName": "tb"}]}}]})
+    run_aux("theta_setop", lambda: eng.sql(
+        f"ON DRUID DATASOURCE lineorder EXECUTE QUERY '{spec}'"))
+
     out = {
         "backend": backend, "rows": rows, "ingest_s": round(ingest_s, 1),
-        "rtt_floor_ms": round(rtt_ms, 2), "queries": prof,
+        "rtt_floor_ms": round(rtt_ms, 2), "queries": prof, "aux": aux,
     }
     name = f"PROFILE_{'TPU' if backend != 'cpu' else 'CPU'}.json"
     with open(os.path.join(REPO, name), "w") as f:
