@@ -76,7 +76,9 @@ _FILTERS = [
 ]
 _TIME_EXPRS = [None, "year(ts)", "month(ts)", "quarter(ts)",
                "date_trunc('day', ts)"]
-_EXTRACT_DIMS = ["substr(城市, 1, 5)", "regexp_extract(cat, '^(a|b)')"]
+_EXTRACT_DIMS = ["substr(城市, 1, 5)", "regexp_extract(cat, '^(a|b)')",
+                 # integer-expression dims (virtual numeric, round 3)
+                 "small + 1", "small * 3 - 2"]
 
 
 def _gen_query(rng):

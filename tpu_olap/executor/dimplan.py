@@ -63,10 +63,17 @@ class DimPlan:
 
 
 def compile_dimension(spec, table, pool, t_min, t_max,
-                      numeric_dim_budget=1 << 20) -> DimPlan:
+                      numeric_dim_budget=1 << 20, vexprs=None) -> DimPlan:
     if isinstance(spec, DefaultDimensionSpec):
         col = spec.dimension
         if col not in table.schema:
+            if vexprs and col in vexprs:
+                # GROUP BY <integer expression>: a virtual column whose
+                # id domain comes from interval arithmetic over its
+                # inputs' min/max metadata (the expression itself is
+                # materialized in the kernel env like any virtual)
+                return _virtual_numeric_dim(spec, col, vexprs[col], table,
+                                            pool, numeric_dim_budget)
             raise UnsupportedDimension(f"unknown dimension {col!r}")
         typ = table.schema[col]
         if typ is ColumnType.STRING:
@@ -77,22 +84,11 @@ def compile_dimension(spec, table, pool, t_min, t_max,
             return DimPlan(spec.name, d.size + 1, labels, col, "codes")
         if typ is ColumnType.LONG:
             md = table.column_metadata([col])[col]
-            lo, hi = md.get("min"), md.get("max")
-            if lo is None:
-                # empty table: single null slot
-                return DimPlan(spec.name, 1, np.array([None], object), col,
-                               "numeric", offset_name=pool.add(0, np.int64))
-            size = int(hi - lo) + 2  # +1 null slot at 0
-            if size > numeric_dim_budget:
-                raise UnsupportedDimension(
-                    f"numeric dimension {col!r} range {size} exceeds dense "
-                    "budget")
-            labels = np.empty(size, object)
-            labels[0] = None
-            labels[1:] = np.arange(lo, hi + 1)
-            # ids = v - (lo - 1): value lo -> 1
-            return DimPlan(spec.name, size, labels, col, "numeric",
-                           offset_name=pool.add(int(lo) - 1, np.int64))
+            lo = md.get("min")
+            return _dense_numeric_plan(
+                spec.name, col, None if lo is None else int(lo),
+                None if lo is None else int(md["max"]),
+                pool, numeric_dim_budget)
         raise UnsupportedDimension(
             f"cannot group by DOUBLE column {col!r} densely")
     if isinstance(spec, ExtractionDimensionSpec):
@@ -124,3 +120,49 @@ def compile_dimension(spec, table, pool, t_min, t_max,
         return DimPlan(spec.name, len(values) + 1, labels, col, "remap",
                        remap_name=pool.add(remap))
     raise UnsupportedDimension(f"unknown dimension spec {type(spec).__name__}")
+
+
+def _dense_numeric_plan(name, source_col, lo, hi, pool,
+                        numeric_dim_budget) -> DimPlan:
+    """Dense numeric dimension over values in [lo, hi] (slot 0 = null;
+    ids = v - (lo - 1)). lo=None means an empty domain."""
+    if lo is None:
+        return DimPlan(name, 1, np.array([None], object), source_col,
+                       "numeric", offset_name=pool.add(0, np.int64))
+    size = hi - lo + 2  # +1 null slot at 0
+    if size > numeric_dim_budget:
+        raise UnsupportedDimension(
+            f"numeric dimension {source_col!r} range {size} exceeds "
+            "dense budget")
+    labels = np.empty(size, object)
+    labels[0] = None
+    labels[1:] = np.arange(lo, hi + 1)
+    return DimPlan(name, size, labels, source_col, "numeric",
+                   offset_name=pool.add(lo - 1, np.int64))
+
+
+def _virtual_numeric_dim(spec, col, expr, table, pool,
+                         numeric_dim_budget) -> DimPlan:
+    from tpu_olap.kernels.pallas_reduce import expr_int_bounds
+    phys = sorted(expr.columns())
+    for c in phys:
+        if c not in table.schema:
+            raise UnsupportedDimension(
+                f"virtual dimension {col!r} references unknown {c!r}")
+        if table.schema[c] is not ColumnType.LONG:
+            raise UnsupportedDimension(
+                f"virtual dimension {col!r} over non-LONG column {c!r}")
+    md = table.column_metadata(set(phys))
+    col_bounds = {}
+    for c in phys:
+        m = md.get(c, {})
+        if m.get("min") is None:
+            return _dense_numeric_plan(spec.name, col, None, None, pool,
+                                       numeric_dim_budget)
+        col_bounds[c] = (int(m["min"]), int(m["max"]))
+    b = expr_int_bounds(expr, col_bounds)
+    if b is None:
+        raise UnsupportedDimension(
+            f"virtual dimension {col!r} is not integer-bounded")
+    return _dense_numeric_plan(spec.name, col, b[0], b[1], pool,
+                               numeric_dim_budget)

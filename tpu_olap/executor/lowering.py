@@ -267,7 +267,8 @@ def _lower_agg(query, table, config) -> PhysicalPlan:
         dim_specs = ()
     dim_plans = [compile_dimension(s, table, pool, t_min, t_max,
                                    numeric_dim_budget=config
-                                   .numeric_dim_label_budget)
+                                   .numeric_dim_label_budget,
+                                   vexprs=vexprs)
                  for s in dim_specs]
     dim_plans = _restrict_dims(dim_plans, query.filter, table, pool)
 

@@ -831,6 +831,22 @@ class _Rewriter:
                 outputs[_key(e)] = OutputColumn(name, "timestamp",
                                                 "datetime")
                 continue
+            if isinstance(e, (BinOp, FuncCall)) and not _contains_agg(e) \
+                    and not _mentions_time_fn(e) \
+                    and TIME_COLUMN not in e.columns():
+                # GROUP BY <integer expression> (histogram bucketing):
+                # lower as a virtual column + dense numeric dimension;
+                # _vcol_for types it, and anything non-LONG (division,
+                # float literals, string inputs) rejects into fallback
+                vname, vt = self._vcol_for(e)
+                if vt != "long":
+                    raise RewriteError(
+                        f"GROUP BY expression {_render(e)!r} is not "
+                        "integer-typed")
+                name = alias or _render(e)
+                dims.append(DefaultDimensionSpec(vname, name))
+                outputs[_key(e)] = OutputColumn(name, name)
+                continue
             raise RewriteError(f"cannot group by {e!r}")
         return dims, granularity, outputs
 
@@ -961,10 +977,12 @@ class _Rewriter:
                 raise RewriteError(
                     f"ORDER BY {_render(e)} is not an output column")
             dim_names = {d.name for d in dims}
+            vlong = {v.name for v in self.vcols if v.output_type == "long"}
             long_dims = {d.name for d in dims
                          if isinstance(d, DefaultDimensionSpec)
-                         and self.table.schema.get(d.dimension)
-                         is ColumnType.LONG}
+                         and (self.table.schema.get(d.dimension)
+                              is ColumnType.LONG
+                              or d.dimension in vlong)}
             order = ("lexicographic"
                      if src in dim_names and src not in long_dims
                      else "numeric")
