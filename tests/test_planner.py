@@ -326,3 +326,68 @@ def test_group_by_integer_expression_rewrites():
     r = eng.sql("SELECT v / 10 AS d, count(*) AS n FROM t GROUP BY v / 10")
     assert not eng.last_plan.rewritten
     assert len(r) > 0
+
+
+def test_group_by_modulo_and_modulo_sum():
+    """Floored-modulo expressions are integer-bounded ([0, m-1] for a
+    positive constant modulus) and ride the device path both as a
+    grouping dimension and as a Pallas-eligible sum input."""
+    import numpy as np
+    import pandas as pd
+
+    from tpu_olap import Engine
+    from tpu_olap.bench.parity import assert_frame_parity
+    from tpu_olap.executor import EngineConfig
+    from tpu_olap.planner.fallback import execute_fallback
+    rng = np.random.default_rng(6)
+    n = 3000
+    df = pd.DataFrame({
+        "ts": pd.to_datetime("2024-03-01"),
+        "g": rng.choice(["a", "b"], n),
+        "v": rng.integers(-80, 200, n).astype(np.int64),  # negatives too
+    })
+    eng = Engine(EngineConfig(fallback_on_device_failure=False))
+    eng.register_table("t", df, time_column="ts")
+    for sql in (
+        "SELECT v % 7 AS m, count(*) AS n FROM t GROUP BY v % 7 "
+        "ORDER BY m",
+        "SELECT g, sum(v % 10) AS s FROM t GROUP BY g ORDER BY g",
+    ):
+        dev = eng.sql(sql)
+        assert eng.last_plan.rewritten, eng.last_plan.fallback_reason
+        fb = execute_fallback(eng.planner.plan(sql).stmt, eng.catalog,
+                              eng.config)
+        assert_frame_parity(dev, fb, ordered=True)
+
+
+def test_virtual_numeric_dim_with_nulls():
+    """Null inputs to an expression dimension land in the null group on
+    BOTH paths (device slot 0 -> None label; pandas NA group)."""
+    import numpy as np
+    import pandas as pd
+
+    from tpu_olap import Engine
+    from tpu_olap.bench.parity import assert_frame_parity
+    from tpu_olap.executor import EngineConfig
+    from tpu_olap.planner.fallback import execute_fallback
+    rng = np.random.default_rng(8)
+    n = 2000
+    v = rng.integers(0, 40, n).astype(np.float64)
+    v[rng.random(n) < 0.1] = np.nan
+    df = pd.DataFrame({
+        "ts": pd.to_datetime("2024-04-01"),
+        "v": pd.array(v, dtype="Int64"),
+    })
+    eng = Engine(EngineConfig(fallback_on_device_failure=False))
+    eng.register_table("t", df, time_column="ts")
+    for sql in (
+        "SELECT v + 1 AS w, count(*) AS n FROM t GROUP BY v + 1 "
+        "ORDER BY w",
+        "SELECT v % 7 AS m, count(*) AS n FROM t GROUP BY v % 7 "
+        "ORDER BY m",
+    ):
+        dev = eng.sql(sql)
+        assert eng.last_plan.rewritten, eng.last_plan.fallback_reason
+        fb = execute_fallback(eng.planner.plan(sql).stmt, eng.catalog,
+                              eng.config)
+        assert_frame_parity(dev, fb, ordered=True)

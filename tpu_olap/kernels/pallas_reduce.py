@@ -84,7 +84,7 @@ def expr_int_bounds(expr, col_bounds):
         return None
     if isinstance(expr, Col):
         return fits(col_bounds.get(expr.name))
-    if isinstance(expr, BinOp) and expr.op in ("+", "-", "*"):
+    if isinstance(expr, BinOp) and expr.op in ("+", "-", "*", "%"):
         a = expr_int_bounds(expr.left, col_bounds)
         b = expr_int_bounds(expr.right, col_bounds)
         if a is None or b is None:
@@ -93,6 +93,14 @@ def expr_int_bounds(expr, col_bounds):
             return fits((a[0] + b[0], a[1] + b[1]))
         if expr.op == "-":
             return fits((a[0] - b[1], a[1] - b[0]))
+        if expr.op == "%":
+            # floored modulo (Python/numpy/jnp/pandas all agree): with a
+            # positive constant modulus the result is in [0, m-1] for
+            # ANY lhs sign; other moduli stay off the device path
+            if not (isinstance(expr.right, Lit) and b[0] == b[1]
+                    and b[0] > 0):
+                return None
+            return fits((0, b[0] - 1))
         prods = [x * y for x in a for y in b]
         return fits((min(prods), max(prods)))
     return None

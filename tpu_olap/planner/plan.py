@@ -977,12 +977,15 @@ class _Rewriter:
                 raise RewriteError(
                     f"ORDER BY {_render(e)} is not an output column")
             dim_names = {d.name for d in dims}
+            # physical columns take precedence over same-named virtual
+            # columns (mirrors compile_dimension's resolution order)
             vlong = {v.name for v in self.vcols if v.output_type == "long"}
             long_dims = {d.name for d in dims
                          if isinstance(d, DefaultDimensionSpec)
                          and (self.table.schema.get(d.dimension)
                               is ColumnType.LONG
-                              or d.dimension in vlong)}
+                              or (d.dimension not in self.table.schema
+                                  and d.dimension in vlong))}
             order = ("lexicographic"
                      if src in dim_names and src not in long_dims
                      else "numeric")
