@@ -789,21 +789,96 @@ def _chunked_aggregate(stmt, chunks, exprs, out_names, group_exprs,
             order_exprs[col] = item.expr
         ascending.append(not item.descending)
 
-    rows = []
+    def vec_merged(e) -> pd.Series:
+        """Vectorized ev_merged over the whole merged frame — the emit
+        is O(groups) and a per-row Python loop dominates at-scale
+        fallback time (200k groups ≈ seconds)."""
+        if isinstance(e, Lit):
+            return pd.Series([e.value] * len(merged), index=merged.index)
+        if isinstance(e, FuncCall) and e.name in AGG_FUNCS:
+            k = _k(e)
+            inner, cond = _unwrap(e)
+            if inner.name in ("count_distinct", "approx_count_distinct",
+                              "theta_sketch"):
+                d = dcounts[k]
+                if not gcols:
+                    return pd.Series([d.get((), 0)] * len(merged),
+                                     index=merged.index)
+                # vectorized lookup: normalize NaN group-key slots to the
+                # string fill exactly like _norm_key, then reindex
+                nf = {}
+                for c in gcols:
+                    s = merged[c]
+                    if not (s.dtype == object
+                            or str(s.dtype).startswith(("str",
+                                                        "category"))):
+                        s = s.astype(object).where(s.notna(), _FILL)
+                    nf[c] = s
+                mi = pd.MultiIndex.from_frame(pd.DataFrame(nf))
+                if d:
+                    lut = pd.Series(list(d.values()),
+                                    index=pd.MultiIndex.from_tuples(d))
+                    vals = lut.reindex(mi).fillna(0).astype("int64")
+                else:
+                    vals = pd.Series(0, index=mi)
+                return pd.Series(vals.to_numpy(), index=merged.index)
+            if inner.name == "count" and not inner.args:
+                s = merged[spec_col[k]] if cond is not None \
+                    else merged["__rows"]
+                return s.astype("int64")
+            if inner.name == "count":
+                return merged[spec_col[k]].astype("int64")
+            if inner.name == "avg":
+                r = (merged[spec_col[k] + "n"] if cond is not None
+                     else merged["__rows"]).astype("float64")
+                # r == 0 -> NaN, matching the scalar `if r else nan`
+                return merged[spec_col[k]].astype("float64") / \
+                    r.where(r != 0, np.nan)
+            return merged[spec_col[k]]
+        k = _k(e)
+        if k in gname_of:
+            s = merged[gname_of[k]]
+            if s.dtype == object or \
+                    str(s.dtype).startswith(("str", "category")):
+                return s.where(s != _FILL, None)
+            return s
+        if isinstance(e, BinOp):
+            l_val = vec_merged(e.left)
+            r_val = vec_merged(e.right)
+            if e.op == "/":
+                lf = pd.to_numeric(l_val, errors="coerce") \
+                    .astype("float64")
+                rf = pd.to_numeric(r_val, errors="coerce") \
+                    .astype("float64")
+                out = (lf / rf.where(rf != 0, 1.0)).where(rf != 0, 0.0)
+                return out.where(~(lf.isna() | rf.isna()), np.nan)
+            return _APPLY[e.op](l_val, r_val)
+        raise FallbackError(
+            f"non-aggregate projection {e!r} with GROUP BY")
+
     if gcols:
         merged = merged.sort_values(gcols, kind="stable")
-    for _, row in merged.iterrows():
-        gkey = tuple(row[c] for c in gcols)
-        rec = {n: ev_merged(e, row, gkey)
-               for n, e in zip(out_names, exprs)}
-        if stmt.having is not None and not _having_ok(
-                stmt.having, None, rec, time_col,
-                lambda x, sub, _r=row, _g=gkey: ev_merged(x, _r, _g)):
-            continue
+    if stmt.having is None:
+        cols = {n: vec_merged(e) for n, e in zip(out_names, exprs)}
         for col, e in order_exprs.items():
-            rec[col] = ev_merged(e, row, gkey)
-        rows.append(rec)
-    out = pd.DataFrame(rows, columns=out_names + list(order_exprs))
+            cols[col] = vec_merged(e)
+        out = pd.DataFrame(cols).reset_index(drop=True)
+    else:
+        # HAVING keeps the scalar path: its NULL-comparison semantics
+        # (_having_ok) are defined per row
+        rows = []
+        for _, row in merged.iterrows():
+            gkey = tuple(row[c] for c in gcols)
+            rec = {n: ev_merged(e, row, gkey)
+                   for n, e in zip(out_names, exprs)}
+            if not _having_ok(
+                    stmt.having, None, rec, time_col,
+                    lambda x, sub, _r=row, _g=gkey: ev_merged(x, _r, _g)):
+                continue
+            for col, e in order_exprs.items():
+                rec[col] = ev_merged(e, row, gkey)
+            rows.append(rec)
+        out = pd.DataFrame(rows, columns=out_names + list(order_exprs))
     if order_cols:
         out = out.sort_values(order_cols, ascending=ascending,
                               kind="stable", key=_null_low_key)
