@@ -55,10 +55,18 @@ class PhysicalPlan:
     make_sparse_kernel: object = None   # cap -> kernel fn (sparse only)
 
     def fingerprint(self) -> tuple:
-        import json
-        t = _template(self.query.to_json())
-        return (self.table.name, json.dumps(t, sort_keys=True), self.statics,
+        # memoized: plans are immutable once lowered and (round 3) cached
+        # across executions, so the template serialization — a couple ms
+        # of json for wide queries — is paid once, not per dispatch
+        fp = getattr(self, "_fp", None)
+        if fp is None:
+            import json
+            t = _template(self.query.to_json())
+            fp = self._fp = (
+                self.table.name, json.dumps(t, sort_keys=True),
+                self.statics,
                 self.pool.signature() if self.pool is not None else ())
+        return fp
 
 
 _LITERAL_KEYS = {"value", "values", "lower", "upper", "pattern", "intervals"}

@@ -52,6 +52,17 @@ class QueryDeadlineExceeded(Exception):
     computation cannot be interrupted."""
 
 
+def _evict_one(cache: dict) -> None:
+    """FIFO-evict one entry, tolerating the abandoned-deadline-thread
+    concurrency (_run_with_deadline): a concurrent insert between iter()
+    and next() raises RuntimeError, a concurrent pop raises KeyError —
+    either just means someone else made room."""
+    try:
+        cache.pop(next(iter(cache), None), None)
+    except (KeyError, RuntimeError):
+        pass
+
+
 class QueryRunner:
     def __init__(self, config: EngineConfig | None = None):
         self.config = config or EngineConfig()
@@ -66,6 +77,7 @@ class QueryRunner:
         self._jit_cache: dict = {}
         self._arg_cache: dict = {}   # uploaded consts/seg-mask, content-keyed
         self._cap_hints: dict = {}   # template -> last observed group count
+        self._plan_cache: dict = {}  # lowered PhysicalPlans, per query JSON
         self._mesh = None
         self._active_shards = config.num_shards if config else None
         self._last_metrics: dict = {}
@@ -242,6 +254,35 @@ class QueryRunner:
             self.history.append(res.metrics)
         return res
 
+    def _lower_cached(self, query, table):
+        """Memoized lower(): re-lowering an unchanged query template
+        costs ~5-10 ms of pure Python (dim/filter/granularity compile +
+        domain restriction) per execution — a large slice of the warm
+        per-query budget. Keyed on the full query JSON plus the
+        lowering-relevant config knobs; a table identity check (not just
+        the name) invalidates on re-registration."""
+        import json as _json
+
+        c = self.config
+        # exactly the config knobs lower() reads (beyond what the query
+        # JSON itself captures); anything else would either mask a live
+        # config change or needlessly fragment the cache
+        key = (table.name,
+               _json.dumps(query.to_json(), sort_keys=True, default=str),
+               c.use_pallas, c.platform, c.enable_x64,
+               str(c.long_dtype), str(c.double_dtype),
+               c.dense_group_budget, c.numeric_dim_label_budget,
+               c.theta_k_cap, c.sparse_theta_k_cap, c.pallas_group_cap,
+               c.pallas_rows_per_block, c.pallas_k_per_block)
+        hit = self._plan_cache.get(key)
+        if hit is not None and hit[0] is table:
+            return hit[1]
+        plan = lower(query, table, self.config)
+        if len(self._plan_cache) > 512:
+            _evict_one(self._plan_cache)
+        self._plan_cache[key] = (table, plan)
+        return plan
+
     def _execute_inner(self, query, table) -> QueryResult:
         if isinstance(query, TimeBoundaryQuerySpec):
             res = self._run_time_boundary(query, table)
@@ -270,6 +311,7 @@ class QueryRunner:
             self._jit_cache.clear()
             self._arg_cache.clear()
             self._cap_hints.clear()
+            self._plan_cache.clear()
         elif table_name in self._datasets:
             self._datasets.pop(table_name).evict()
             self._jit_cache = {k: v for k, v in list(self._jit_cache.items())
@@ -278,6 +320,11 @@ class QueryRunner:
                                if k[0] != table_name}
             self._cap_hints = {k: v for k, v in list(self._cap_hints.items())
                                if k[0] != table_name}
+            # plans pin their TableSegments (host column arrays): drop
+            # them too or a re-registration keeps the old data alive
+            self._plan_cache = {k: v for k, v
+                                in list(self._plan_cache.items())
+                                if k[0] != table_name}
 
     # ------------------------------------------------------------- dispatch
 
@@ -366,7 +413,7 @@ class QueryRunner:
             consts_dev = jax.device_put(consts)
             seg_arg = jax.device_put(seg_mask)
         if len(self._arg_cache) > 256:
-            self._arg_cache.pop(next(iter(self._arg_cache)))
+            _evict_one(self._arg_cache)
         self._arg_cache[ckey] = (consts_dev, seg_arg)
         return consts_dev, seg_arg
 
@@ -571,7 +618,7 @@ class QueryRunner:
     def _run_agg(self, query, table) -> QueryResult:
         metrics = self._last_metrics = {}
         t0 = time.perf_counter()
-        plan = lower(query, table, self.config)
+        plan = self._lower_cached(query, table)
         metrics["lower_ms"] = (time.perf_counter() - t0) * 1000
         specs = agg_specs_by_name(query.aggregations)
         # theta set-op post-aggs consume RAW sketch tables host-side;
@@ -767,7 +814,7 @@ class QueryRunner:
     def _run_scan(self, query, table) -> QueryResult:
         metrics = self._last_metrics = {}
         t0 = time.perf_counter()
-        plan = lower(query, table, self.config)
+        plan = self._lower_cached(query, table)
         metrics["lower_ms"] = (time.perf_counter() - t0) * 1000
         partials = self._dispatch(
             lambda: self._run_partials(plan, metrics), metrics, table.name)
@@ -885,7 +932,7 @@ class QueryRunner:
                 virtual_columns=query.virtual_columns,
             )
             metrics = self._last_metrics
-            plan = lower(mask_query, table, self.config)
+            plan = self._lower_cached(mask_query, table)
             partials = self._dispatch(
                 lambda: self._run_partials(plan, metrics), metrics,
                 table.name)
