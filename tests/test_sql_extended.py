@@ -528,3 +528,50 @@ def test_correlated_subquery_rejected_clearly():
     with pytest.raises(FallbackError, match="correlated subquery"):
         eng.sql("SELECT count(*) AS n FROM t "
                 "WHERE v > (SELECT max(v) FROM u WHERE u.g = t.g)")
+
+
+def test_case_folding_extraction_dims():
+    """upper()/lower() ride the device path as extraction dimensions and
+    as extraction-fn selector filters (Druid's upper/lower extraction)."""
+    from tpu_olap.planner.fallback import execute_fallback
+    eng, df = _engine()
+    sql = ("SELECT upper(g) AS u, sum(v) AS s FROM t "
+           "GROUP BY upper(g) ORDER BY u")
+    dev = eng.sql(sql)
+    assert eng.last_plan.rewritten
+    fb = execute_fallback(eng.planner.plan(sql).stmt, eng.catalog,
+                          eng.config)
+    pd.testing.assert_frame_equal(dev, fb, check_dtype=False)
+    want = df.assign(u=df.g.str.upper()).groupby("u")["v"].sum()
+    assert dev["s"].tolist() == want.tolist()
+    n = eng.sql("SELECT count(*) AS n FROM t WHERE upper(g) = 'A'")
+    assert eng.last_plan.rewritten
+    assert n["n"][0] == int((df.g == "a").sum())
+
+
+def test_hour_minute_extractions():
+    from tpu_olap.planner.fallback import execute_fallback
+    eng, df = _engine()
+    for sql in (
+        "SELECT hour(ts) AS h, count(*) AS n FROM t GROUP BY hour(ts) "
+        "ORDER BY h",
+        "SELECT minute(ts) AS m, count(*) AS n FROM t "
+        "WHERE ts < '2023-01-03' GROUP BY minute(ts) ORDER BY m LIMIT 10",
+    ):
+        dev = eng.sql(sql)
+        assert eng.last_plan.rewritten, eng.last_plan.fallback_reason
+        fb = execute_fallback(eng.planner.plan(sql).stmt, eng.catalog,
+                              eng.config)
+        pd.testing.assert_frame_equal(dev, fb, check_dtype=False)
+
+
+def test_concat_and_trim_fallback():
+    eng, df = _engine()
+    got = eng.sql("SELECT concat(g, '/', city) AS gc, count(*) AS n "
+                  "FROM t GROUP BY concat(g, '/', city) ORDER BY gc")
+    assert not eng.last_plan.rewritten
+    want = (df.g + "/" + df.city).value_counts().sort_index()
+    assert got["gc"].tolist() == want.index.tolist()
+    assert got["n"].tolist() == want.tolist()
+    got = eng.sql("SELECT count(*) AS n FROM t WHERE trim(g) = 'a'")
+    assert got["n"][0] == int((df.g == "a").sum())

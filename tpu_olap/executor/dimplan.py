@@ -99,7 +99,8 @@ def compile_dimension(spec, table, pool, t_min, t_max,
                 raise UnsupportedDimension(
                     "timeFormat extraction only on __time")
             plan, remap_name, values = compile_time_format(
-                ex.format, ex.time_zone, t_min, t_max, pool)
+                ex.format, ex.time_zone, t_min, t_max, pool,
+                bucket_budget=numeric_dim_budget)
             labels = np.array(values, object)
             return DimPlan(spec.name, len(values), labels, None,
                            "timeformat", remap_name=remap_name,

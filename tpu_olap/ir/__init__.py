@@ -22,7 +22,8 @@ from tpu_olap.ir.filters import (  # noqa: F401
 from tpu_olap.ir.dimensions import (  # noqa: F401
     DimensionSpec, DefaultDimensionSpec, ExtractionDimensionSpec,
     ExtractionFunctionSpec, TimeFormatExtractionFn, RegexExtractionFn,
-    SubstringExtractionFn, LookupExtractionFn, VirtualColumn,
+    SubstringExtractionFn, LookupExtractionFn, CaseExtractionFn,
+    VirtualColumn,
 )
 from tpu_olap.ir.aggregations import (  # noqa: F401
     AggregationSpec, CountAggregation, SumAggregation, MinAggregation,

@@ -74,6 +74,21 @@ class SubstringExtractionFn(ExtractionFunctionSpec):
         return SubstringExtractionFn(int(d["index"]), d.get("length"))
 
 
+@register("extractionFn", "lower")
+@register("extractionFn", "upper")
+@dataclass(frozen=True)
+class CaseExtractionFn(ExtractionFunctionSpec):
+    """Druid's upper/lower extraction functions (case folding)."""
+    mode: str  # "upper" | "lower"
+
+    def to_json(self):
+        return {"type": self.mode}
+
+    @staticmethod
+    def from_json(d):
+        return CaseExtractionFn(d["type"])
+
+
 @register("extractionFn", "lookup")
 @dataclass(frozen=True)
 class LookupExtractionFn(ExtractionFunctionSpec):

@@ -17,7 +17,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_olap.ir import filters as F
-from tpu_olap.ir.dimensions import (LookupExtractionFn, RegexExtractionFn,
+from tpu_olap.ir.dimensions import (CaseExtractionFn, LookupExtractionFn,
+                                    RegexExtractionFn,
                                     SubstringExtractionFn,
                                     TimeFormatExtractionFn)
 from tpu_olap.kernels.exprs import eval_expr
@@ -339,6 +340,8 @@ def _extraction_callable(ex):
                 return table[v]
             return v if ex.retain_missing_value else ex.replace_missing_value
         return f
+    if isinstance(ex, CaseExtractionFn):
+        return str.upper if ex.mode == "upper" else str.lower
     if isinstance(ex, TimeFormatExtractionFn):
         raise UnsupportedFilter(
             "timeFormat extraction in filters: use intervals instead")

@@ -142,14 +142,30 @@ def strftime_of(fmt: str) -> str:
     return out
 
 
-def compile_time_format(fmt: str, tz: str, t_min: int, t_max: int, pool):
+_APPROX_PERIOD_MS = {"PT1S": 1_000, "PT1M": 60_000, "PT1H": 3_600_000,
+                     "P1D": 86_400_000}
+
+
+def compile_time_format(fmt: str, tz: str, t_min: int, t_max: int, pool,
+                        bucket_budget: int | None = None):
     """TimeFormatExtractionFn -> (BucketPlan over the finest needed period,
     remap const name, group value strings).
 
     Device work: fine bucket id -> gather remap -> dense group id. The
     formatted strings (group labels) are computed host-side only for the
     bucket *starts* — never per row (SURVEY.md §8.2's host/device split).
+    bucket_budget bounds the fine-bucket count BEFORE materializing it:
+    second(ts) over an unfiltered multi-year table would otherwise build
+    tens of millions of bucket starts host-side (and a matching remap
+    constant); exceeding the budget rejects into the fallback.
     """
+    if bucket_budget is not None:
+        period_est = format_finest_period(fmt)
+        ms = _APPROX_PERIOD_MS.get(period_est)
+        if ms is not None and (t_max - t_min) / ms + 1 > bucket_budget:
+            raise UnsupportedGranularity(
+                f"timeFormat {fmt!r} over this time span needs more than "
+                f"{bucket_budget} fine buckets; narrow the intervals")
     import datetime as dt
     from zoneinfo import ZoneInfo
 

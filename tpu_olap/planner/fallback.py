@@ -22,7 +22,8 @@ from tpu_olap.planner.exprutil import (contains_agg as _contains_agg,
 from tpu_olap.planner.sqlparse import (AGG_FUNCS, SelectStmt, UnionStmt)
 from tpu_olap.segments.dictionary import _like_to_regex
 
-_TIME_FUNCS = {"year", "month", "day", "dayofmonth", "quarter"}
+_TIME_FUNCS = {"year", "month", "day", "dayofmonth", "quarter",
+               "hour", "minute", "second"}
 
 
 class FallbackError(Exception):
@@ -136,6 +137,17 @@ def _execute_union(stmt: UnionStmt, catalog, config) -> pd.DataFrame:
     lo = stmt.offset
     hi = None if stmt.limit is None else lo + stmt.limit
     return out.iloc[lo:hi].reset_index(drop=True)
+
+
+def _as_str_series(v, df, fn: str) -> pd.Series:
+    """Coerce a string-function argument to a Series, with a legible
+    error for non-string input (raw .str would raise AttributeError)."""
+    s = v if isinstance(v, pd.Series) else pd.Series(v, index=df.index)
+    if not (s.dtype == object or str(s.dtype).startswith(("str",
+                                                          "category"))):
+        raise FallbackError(
+            f"{fn}() needs a string argument, got {s.dtype}")
+    return s
 
 
 def _check_uncorrelated(stmt):
@@ -1024,6 +1036,22 @@ def _eval(e, df, time_col):
                     {"month": "M", "quarter": "Q", "year": "Y",
                      "week": "W-SUN"}[unit]).dt.start_time
             return t.dt.floor(freq)
+        if fn in ("upper", "lower", "trim"):
+            s = _as_str_series(_eval(e.args[0], df, time_col), df, fn)
+            if fn == "upper":
+                return s.str.upper()
+            if fn == "lower":
+                return s.str.lower()
+            # SQL/Druid TRIM strips space characters only by default
+            return s.str.strip(" ")
+        if fn == "concat":
+            parts = [_eval(a, df, time_col) for a in e.args]
+            out = None
+            for p in parts:
+                s = p.astype("string") if hasattr(p, "astype") else \
+                    pd.Series(str(p), index=df.index, dtype="string")
+                out = s if out is None else out + s
+            return out
         if fn == "not":
             v = _eval(e.args[0], df, time_col)
             return (~v.astype(bool)) if hasattr(v, "astype") else (not v)

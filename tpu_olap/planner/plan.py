@@ -50,7 +50,8 @@ class RewriteError(Exception):
 _CMP = ("==", "!=", "<", "<=", ">", ">=")
 _TIME_FUNCS = {"year": ("YYYY", "int"), "month": ("MM", "int"),
                "day": ("dd", "int"), "dayofmonth": ("dd", "int"),
-               "quarter": ("Q", "int")}
+               "quarter": ("Q", "int"), "hour": ("HH", "int"),
+               "minute": ("mm", "int"), "second": ("ss", "int")}
 _TRUNC_UNITS = {"second": "PT1S", "minute": "PT1M", "hour": "PT1H",
                 "day": "P1D", "week": "P1W", "month": "P1M",
                 "quarter": "P3M", "year": "P1Y"}
@@ -561,6 +562,13 @@ class _Rewriter:
                 raise RewriteError("substr start index is 1-based")
             length = int(e.args[2].value) if len(e.args) == 3 else None
             return col, SubstringExtractionFn(start - 1, length)
+        if e.name in ("upper", "lower") and len(e.args) == 1:
+            from tpu_olap.ir.dimensions import CaseExtractionFn
+            col = self._check_col(e.args[0].name)
+            if self._col_type(col) is not ColumnType.STRING:
+                raise RewriteError(
+                    f"{e.name} over non-string column {col!r}")
+            return col, CaseExtractionFn(e.name)
         if e.name == "regexp_extract" and len(e.args) == 2 and \
                 isinstance(e.args[1], Lit) and isinstance(e.args[1].value,
                                                           str):
