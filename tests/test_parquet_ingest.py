@@ -76,3 +76,19 @@ def test_arrow_register_no_pandas_detour():
     got = eng.sql("SELECT g, count(*) AS n FROM t GROUP BY g ORDER BY g")
     assert int(got.n.sum()) == n
     assert entry._frame is None
+
+
+def test_nanosecond_timestamps_truncate_to_ms():
+    """Druid's __time is ms-grained: ns-precision sources truncate at
+    ingest instead of raising ArrowInvalid (safe-cast failure)."""
+    import numpy as np
+    import pandas as pd
+
+    from tpu_olap import Engine
+    eng = Engine()
+    ts = pd.to_datetime("2020-01-01") + pd.to_timedelta(
+        np.arange(100) * 1_000_000_123, unit="ns")  # not ms-aligned
+    df = pd.DataFrame({"ts": ts, "v": np.arange(100, dtype=np.int64)})
+    eng.register_table("t", df, time_column="ts")
+    got = eng.sql("SELECT count(*) AS n, sum(v) AS s FROM t")
+    assert int(got["n"][0]) == 100 and int(got["s"][0]) == 4950

@@ -142,10 +142,6 @@ def strftime_of(fmt: str) -> str:
     return out
 
 
-_APPROX_PERIOD_MS = {"PT1S": 1_000, "PT1M": 60_000, "PT1H": 3_600_000,
-                     "P1D": 86_400_000}
-
-
 def compile_time_format(fmt: str, tz: str, t_min: int, t_max: int, pool,
                         bucket_budget: int | None = None):
     """TimeFormatExtractionFn -> (BucketPlan over the finest needed period,
@@ -161,7 +157,10 @@ def compile_time_format(fmt: str, tz: str, t_min: int, t_max: int, pool,
     """
     if bucket_budget is not None:
         period_est = format_finest_period(fmt)
-        ms = _APPROX_PERIOD_MS.get(period_est)
+        try:
+            ms = timeutil.period_millis(period_est)
+        except ValueError:
+            ms = None  # calendar periods: bucket counts are small
         if ms is not None and (t_max - t_min) / ms + 1 > bucket_budget:
             raise UnsupportedGranularity(
                 f"timeFormat {fmt!r} over this time span needs more than "

@@ -121,8 +121,11 @@ def _convert_time(tcol, n: int):
             "required per row (like Druid's __time)")
     t = tcol.type
     if pa.types.is_timestamp(t):
-        tms = pc.cast(tcol, pa.timestamp("ms"))
-        v = tms.combine_chunks().to_numpy(zero_copy_only=False)
+        # Druid's __time is millisecond-grained: sub-ms precision FLOORS
+        # via numpy's datetime64 unit conversion (uniform across the
+        # epoch — an unsafe Arrow cast would truncate pre-1970 values
+        # toward zero, i.e. 1 ms late) instead of raising ArrowInvalid
+        v = tcol.combine_chunks().to_numpy(zero_copy_only=False)
         return v.astype("datetime64[ms]").astype(np.int64)
     if pa.types.is_date(t):
         return (tcol.combine_chunks().to_numpy(zero_copy_only=False)
@@ -163,8 +166,8 @@ def _convert_column(arr, n: int):
                     np.where(null_mask, 0, v).astype(np.int64), null_mask)
         return ColumnType.LONG, v.astype(np.int64), None
     if pa.types.is_timestamp(t) or pa.types.is_date(t):
-        v = (pc.cast(arr, pa.timestamp("ms"))
-             .to_numpy(zero_copy_only=False)
+        # numpy unit conversion floors uniformly (see time-column note)
+        v = (arr.to_numpy(zero_copy_only=False)
              .astype("datetime64[ms]").astype(np.int64))
         return ColumnType.LONG, v, null_mask if null_mask.any() else None
     if pa.types.is_decimal(t):
