@@ -145,3 +145,74 @@ def test_json_round_trip():
     pa = postagg_from_json(d)
     assert pa.to_json()["field"]["func"] == "NOT"
     assert pa.inputs() == {"a", "b"}
+
+
+def test_sql_theta_setops(setup):
+    """SQL spellings: theta_sketch_intersect/union/not over FILTERed
+    theta sketches rewrite to set-op post-aggs on the device path; the
+    fallback computes exact sets, and under-capacity sketches make the
+    device estimates exact too."""
+    from tpu_olap.planner.fallback import execute_fallback
+    eng, df = setup
+    buyers = set(df[df.action == "buy"].user)
+    viewers = set(df[df.action == "view"].user)
+    sharers = set(df[df.action == "share"].user)
+    cases = [
+        ("theta_sketch_estimate(theta_sketch_intersect("
+         "theta_sketch(user) FILTER (WHERE action = 'buy'), "
+         "theta_sketch(user) FILTER (WHERE action = 'view')))",
+         len(buyers & viewers)),
+        ("theta_sketch_union("
+         "theta_sketch(user) FILTER (WHERE action = 'buy'), "
+         "theta_sketch(user) FILTER (WHERE action = 'share'))",
+         len(buyers | sharers)),
+        ("theta_sketch_not("
+         "theta_sketch(user) FILTER (WHERE action = 'buy'), "
+         "theta_sketch(user) FILTER (WHERE action = 'view'))",
+         len(buyers - viewers)),
+        ("theta_sketch_intersect("
+         "theta_sketch(user) FILTER (WHERE action = 'buy'), "
+         "theta_sketch_union("
+         "theta_sketch(user) FILTER (WHERE action = 'view'), "
+         "theta_sketch(user) FILTER (WHERE action = 'share')))",
+         len(buyers & (viewers | sharers))),
+    ]
+    for expr, want in cases:
+        sql = f"SELECT {expr} AS x FROM events"
+        dev = eng.sql(sql)
+        assert eng.last_plan.rewritten, eng.last_plan.fallback_reason
+        assert int(dev["x"][0]) == want, (expr, dev["x"][0], want)
+        fb = execute_fallback(eng.planner.plan(sql).stmt, eng.catalog,
+                              eng.config)
+        assert int(fb["x"][0]) == want
+
+
+def test_sql_theta_setop_grouped(setup):
+    from tpu_olap.planner.fallback import execute_fallback
+    eng, df = setup
+    sql = ("SELECT device, theta_sketch_intersect("
+           "theta_sketch(user) FILTER (WHERE action = 'buy'), "
+           "theta_sketch(user) FILTER (WHERE action = 'view')) AS b "
+           "FROM events GROUP BY device ORDER BY device")
+    dev = eng.sql(sql)
+    assert eng.last_plan.rewritten
+    fb = execute_fallback(eng.planner.plan(sql).stmt, eng.catalog,
+                          eng.config)
+    for (_, r1), (_, r2) in zip(dev.iterrows(), fb.iterrows()):
+        sub = df[df.device == r1["device"]]
+        want = len(set(sub[sub.action == "buy"].user)
+                   & set(sub[sub.action == "view"].user))
+        assert int(r1["b"]) == want and int(r2["b"]) == want
+
+
+def test_sql_theta_setop_bad_arg_falls_back(setup):
+    """A non-theta argument rejects the rewrite; the fallback then raises
+    the same legible error."""
+    import pytest as _p
+
+    from tpu_olap.planner.fallback import FallbackError
+    eng, _ = setup
+    with _p.raises(FallbackError, match="theta_sketch"):
+        eng.sql("SELECT theta_sketch_intersect(sum(user), "
+                "theta_sketch(user)) AS x FROM events")
+    assert not eng.last_plan.rewritten

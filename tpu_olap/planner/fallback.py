@@ -24,6 +24,8 @@ from tpu_olap.segments.dictionary import _like_to_regex
 
 _TIME_FUNCS = {"year", "month", "day", "dayofmonth", "quarter",
                "hour", "minute", "second"}
+_THETA_SET_FNS = {"theta_sketch_intersect", "theta_sketch_union",
+                  "theta_sketch_not"}
 
 
 class FallbackError(Exception):
@@ -343,13 +345,16 @@ def _aggregate(df, exprs, out_names, group_exprs, stmt, time_col):
         gname_of[_k(g)] = name
     kdf = pd.DataFrame(gkeys) if gkeys else None
 
+    def _filtered(sub, cond):
+        m = _eval(cond, sub, time_col)
+        m = pd.Series(m, index=sub.index).fillna(False).astype(bool)
+        return sub[m]
+
     def agg_series(e, sub):
         if isinstance(e, FuncCall) and e.name in AGG_FUNCS:
             if e.name == "agg_filter":
                 inner, cond = e.args
-                m = _eval(cond, sub, time_col)
-                m = pd.Series(m, index=sub.index).fillna(False).astype(bool)
-                return agg_series(inner, sub[m])
+                return agg_series(inner, _filtered(sub, cond))
             if e.name == "count" and not e.args:
                 return len(sub)
             if e.name == "count":
@@ -372,6 +377,13 @@ def _aggregate(df, exprs, out_names, group_exprs, stmt, time_col):
             if e.name == "avg":
                 return v.sum() / len(sub) if len(sub) else np.nan
             raise FallbackError(f"unknown aggregate {e.name!r}")
+        if isinstance(e, FuncCall) and e.name in _THETA_SET_FNS:
+            return float(len(_theta_set(e, sub)))
+        if isinstance(e, FuncCall) and e.name == "theta_sketch_estimate" \
+                and len(e.args) == 1:
+            # _theta_set validates the argument IS a sketch (a plain
+            # aggregate must error, not pass through as an "estimate")
+            return float(len(_theta_set(e.args[0], sub)))
         if isinstance(e, BinOp):
             l_val = agg_series(e.left, sub)
             r_val = agg_series(e.right, sub)
@@ -385,6 +397,37 @@ def _aggregate(df, exprs, out_names, group_exprs, stmt, time_col):
         if isinstance(e, Lit):
             return e.value
         raise FallbackError(f"non-aggregate projection {e!r} with GROUP BY")
+
+    def _theta_set(e, sub) -> set:
+        """Exact value set for a theta set-op tree (the fallback's exact
+        analog of the device's KMV set operations)."""
+        if isinstance(e, FuncCall) and e.name in _THETA_SET_FNS:
+            if len(e.args) < 2:  # arity parity with the device rewrite
+                raise FallbackError(
+                    f"{e.name} takes at least two arguments")
+            parts = [_theta_set(a, sub) for a in e.args]
+            if e.name == "theta_sketch_union":
+                return set().union(*parts)
+            if e.name == "theta_sketch_intersect":
+                out = parts[0]
+                for p in parts[1:]:
+                    out = out & p
+                return out
+            out = parts[0]
+            for p in parts[1:]:
+                out = out - p
+            return out
+        inner, sub2 = e, sub
+        if isinstance(e, FuncCall) and e.name == "agg_filter":
+            inner = e.args[0]
+            sub2 = _filtered(sub, e.args[1])
+        if not (isinstance(inner, FuncCall)
+                and inner.name == "theta_sketch"):
+            raise FallbackError(
+                "theta sketch functions take theta_sketch(...) arguments "
+                f"(optionally with FILTER), got {inner!r}")
+        return set(_eval_agg_input(inner.args[0], sub2, time_col)
+                   .dropna())
 
     rows = []
     if kdf is None:

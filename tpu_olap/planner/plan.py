@@ -777,9 +777,25 @@ class _Rewriter:
         self._agg_by_key[k] = name
         return name
 
+    _THETA_SET_FNS = {"theta_sketch_intersect": "INTERSECT",
+                      "theta_sketch_union": "UNION",
+                      "theta_sketch_not": "NOT"}
+
     def _to_postagg(self, e: Expr, name: str = ""):
         if isinstance(e, Lit):
             return ConstantPostAgg(float(e.value), name)
+        if isinstance(e, FuncCall) and e.name in self._THETA_SET_FNS:
+            return self._theta_setop(e, name)
+        if isinstance(e, FuncCall) and e.name == "theta_sketch_estimate" \
+                and len(e.args) == 1:
+            from tpu_olap.ir.postaggs import ThetaSketchEstimatePostAgg
+            inner = e.args[0]
+            if isinstance(inner, FuncCall) and \
+                    inner.name in self._THETA_SET_FNS:
+                return ThetaSketchEstimatePostAgg(
+                    "", name, self._theta_setop(inner))
+            return ThetaSketchEstimatePostAgg(
+                self._theta_field(inner, "theta_sketch_estimate"), name)
         if isinstance(e, FuncCall) and e.name in AGG_FUNCS:
             return FieldAccessPostAgg(self._make_agg(e), name)
         if isinstance(e, BinOp) and e.op in ("+", "-", "*", "/"):
@@ -787,6 +803,36 @@ class _Rewriter:
                                      (self._to_postagg(e.left),
                                       self._to_postagg(e.right)))
         raise RewriteError(f"cannot translate aggregate expression {e!r}")
+
+    def _theta_field(self, e: Expr, ctx: str) -> str:
+        """An argument of `ctx` must BE a theta sketch: either
+        theta_sketch(col) or theta_sketch(col) FILTER (WHERE ...)."""
+        inner = e
+        if isinstance(e, FuncCall) and e.name == "agg_filter":
+            inner = e.args[0]
+        if not (isinstance(inner, FuncCall)
+                and inner.name == "theta_sketch"):
+            raise RewriteError(
+                f"{ctx} takes theta_sketch(...) arguments "
+                f"(optionally with FILTER), got {inner!r}")
+        return self._make_agg(e)
+
+    def _theta_setop(self, e: FuncCall, name: str = ""):
+        """SQL spelling of the datasketches set ops (SURVEY.md §3.3):
+        theta_sketch_intersect/union/not over theta sketches -> the
+        thetaSketchSetOp post-aggregation tree."""
+        from tpu_olap.ir.postaggs import ThetaSketchSetOpPostAgg
+        if len(e.args) < 2:
+            raise RewriteError(f"{e.name} takes at least two arguments")
+        fields = []
+        for a in e.args:
+            if isinstance(a, FuncCall) and a.name in self._THETA_SET_FNS:
+                fields.append(self._theta_setop(a))
+            else:
+                fields.append(FieldAccessPostAgg(
+                    self._theta_field(a, e.name)))
+        return ThetaSketchSetOpPostAgg(self._THETA_SET_FNS[e.name],
+                                       tuple(fields), name)
 
     # ------------------------------------------------------------- group by
 
