@@ -204,3 +204,43 @@ def test_fuzz_chunked_vs_whole(tmp_path, seed):
     except AssertionError:
         print(f"CHUNKED FUZZ FAILURE seed={seed}\nSQL: {sql}")
         raise
+
+
+def test_chunked_theta_setops(tmp_path):
+    """Theta set ops at SF scale: the chunked fallback joins the
+    distinct-pair frames per group — exact, bounded-memory."""
+    import numpy as np
+    import pandas as pd
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from tpu_olap import Engine
+    from tpu_olap.executor import EngineConfig
+    rng = np.random.default_rng(4)
+    n = 30_000
+    df = pd.DataFrame({
+        "ts": pd.to_datetime("2024-01-01")
+        + pd.to_timedelta(rng.integers(0, 86400 * 20, n), unit="s"),
+        "user": rng.integers(0, 2500, n),
+        "action": rng.choice(["buy", "view"], n),
+        "dev": rng.choice(["a", "b", "c"], n),
+    })
+    p = str(tmp_path / "ev.parquet")
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), p)
+    eng = Engine(EngineConfig(fallback_chunk_rows=5_000,
+                              fallback_chunk_batch_rows=4096))
+    eng.register_table("ev", p, time_column="ts", accelerate=False)
+    got = eng.sql(
+        "SELECT dev, theta_sketch_intersect("
+        "theta_sketch(user) FILTER (WHERE action = 'buy'), "
+        "theta_sketch(user) FILTER (WHERE action = 'view')) AS b, "
+        "theta_sketch_not("
+        "theta_sketch(user) FILTER (WHERE action = 'buy'), "
+        "theta_sketch(user) FILTER (WHERE action = 'view')) AS only_b "
+        "FROM ev GROUP BY dev ORDER BY dev")
+    for _, r in got.iterrows():
+        sub = df[df.dev == r["dev"]]
+        buy = set(sub[sub.action == "buy"].user)
+        view = set(sub[sub.action == "view"].user)
+        assert int(r["b"]) == len(buy & view)
+        assert int(r["only_b"]) == len(buy - view)

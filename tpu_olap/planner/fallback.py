@@ -141,6 +141,15 @@ def _execute_union(stmt: UnionStmt, catalog, config) -> pd.DataFrame:
     return out.iloc[lo:hi].reset_index(drop=True)
 
 
+def _norm_gcol(s: pd.Series) -> pd.Series:
+    """Group-key column with numeric NaNs normalized to the string fill
+    (matching _norm_key), so dict/merge/reindex keys line up."""
+    if not (s.dtype == object
+            or str(s.dtype).startswith(("str", "category"))):
+        return s.astype(object).where(s.notna(), _FILL)
+    return s
+
+
 def _as_str_series(v, df, fn: str) -> pd.Series:
     """Coerce a string-function argument to a Series, with a legible
     error for non-string input (raw .str would raise AttributeError)."""
@@ -362,6 +371,9 @@ def _aggregate(df, exprs, out_names, group_exprs, stmt, time_col):
                     .notna().sum()
             if e.name in ("count_distinct", "approx_count_distinct",
                           "theta_sketch"):
+                if e.name == "theta_sketch" and len(e.args) != 1:
+                    # single-field, like the device aggregator
+                    raise FallbackError("theta_sketch takes one column")
                 vals = [_eval_agg_input(a, sub, time_col) for a in e.args]
                 if len(vals) == 1:
                     return vals[0].dropna().nunique()
@@ -670,6 +682,8 @@ def _chunked_aggregate(stmt, chunks, exprs, out_names, group_exprs,
                                  index=df.index).fillna(False).astype(bool)
             if e.name in ("count_distinct", "approx_count_distinct",
                           "theta_sketch"):
+                if e.name == "theta_sketch" and len(e.args) != 1:
+                    raise FallbackError("theta_sketch takes one column")
                 sub = df if mask is None else df[mask]
                 gsub = {n: (work[n] if mask is None else work[n][mask])
                         for n in gcols}
@@ -794,6 +808,87 @@ def _chunked_aggregate(stmt, chunks, exprs, out_names, group_exprs,
 
     spec_col = {k: f"p{i}" for i, (k, _) in enumerate(specs)}
 
+    # ---- theta set ops over the distinct-pair frames (SF-scale analog
+    # of the in-memory exact sets): each sketch argument's (group, value)
+    # pairs are already accumulated; set algebra is frame algebra.
+    def _norm_pairs(f: pd.DataFrame) -> pd.DataFrame:
+        # pandas merges do not match NaN keys: normalize numeric
+        # group-key NaNs to the string fill (strings already carry it).
+        # __v is object-typed so differently-typed sketches merge to the
+        # empty set (like the in-memory path) instead of raising, and
+        # only the FIRST value column counts (theta is single-field;
+        # extra pair columns would explode the joins many-to-many).
+        out = {c: _norm_gcol(f[c]) for c in gcols}
+        out["__v"] = f[f.columns[len(gcols)]].astype(object)
+        return pd.DataFrame(out).drop_duplicates(ignore_index=True)
+
+    def _setop_frame(e) -> pd.DataFrame:
+        if isinstance(e, FuncCall) and e.name in _THETA_SET_FNS:
+            if len(e.args) < 2:
+                raise FallbackError(
+                    f"{e.name} takes at least two arguments")
+            parts = [_setop_frame(a) for a in e.args]
+            on = gcols + ["__v"]
+            if e.name == "theta_sketch_union":
+                return pd.concat(parts, ignore_index=True) \
+                    .drop_duplicates(ignore_index=True)
+            if e.name == "theta_sketch_intersect":
+                out = parts[0]
+                for p in parts[1:]:
+                    out = out.merge(p, on=on)
+                return out
+            out = parts[0]
+            for p in parts[1:]:
+                m = out.merge(p, on=on, how="left", indicator=True)
+                out = m[m["_merge"] == "left_only"].drop(columns="_merge")
+            return out
+        inner = e.args[0] if isinstance(e, FuncCall) \
+            and e.name == "agg_filter" else e
+        if not (isinstance(inner, FuncCall)
+                and inner.name == "theta_sketch"):
+            raise FallbackError(
+                "theta sketch functions take theta_sketch(...) arguments "
+                f"(optionally with FILTER), got {inner!r}")
+        ka = _k(e)
+        cached = norm_pairs_cache.get(ka)
+        if cached is None:
+            cached = _norm_pairs(pair_parts[ka][0]) if pair_parts.get(ka) \
+                else pd.DataFrame(columns=gcols + ["__v"])
+            norm_pairs_cache[ka] = cached
+        return cached
+
+    setop_counts: dict = {}
+    norm_pairs_cache: dict = {}
+
+    def _setop_count_dict(e) -> dict:
+        k = _k(e)
+        if k not in setop_counts:
+            f = _setop_frame(e)
+            if gcols:
+                sizes = f.groupby(gcols, sort=False, dropna=False).size()
+                setop_counts[k] = {
+                    _norm_key(kk if isinstance(kk, tuple) else (kk,)):
+                    int(v) for kk, v in sizes.items()}
+            else:
+                setop_counts[k] = {(): len(f)}
+        return setop_counts[k]
+
+    def _estimate_arg(e):
+        """theta_sketch_estimate argument: a setop node, or a validated
+        leaf sketch (a non-sketch aggregate must error, not pass
+        through)."""
+        a = e.args[0]
+        if isinstance(a, FuncCall) and a.name in _THETA_SET_FNS:
+            return a, True
+        inner = a.args[0] if isinstance(a, FuncCall) \
+            and a.name == "agg_filter" else a
+        if not (isinstance(inner, FuncCall)
+                and inner.name == "theta_sketch"):
+            raise FallbackError(
+                "theta sketch functions take theta_sketch(...) arguments "
+                f"(optionally with FILTER), got {inner!r}")
+        return a, False
+
     def merged_agg(e, row, gkey):
         k = _k(e)
         inner, cond = _unwrap(e)
@@ -814,6 +909,14 @@ def _chunked_aggregate(stmt, chunks, exprs, out_names, group_exprs,
     def ev_merged(e, row, gkey):
         if isinstance(e, Lit):
             return e.value
+        if isinstance(e, FuncCall) and e.name in _THETA_SET_FNS:
+            return float(_setop_count_dict(e).get(_norm_key(gkey), 0))
+        if isinstance(e, FuncCall) and e.name == "theta_sketch_estimate" \
+                and len(e.args) == 1:
+            a, is_setop = _estimate_arg(e)
+            if is_setop:
+                return float(_setop_count_dict(a).get(_norm_key(gkey), 0))
+            return float(merged_agg(a, row, gkey))
         if isinstance(e, FuncCall) and e.name in AGG_FUNCS:
             return merged_agg(e, row, gkey)
         k = _k(e)
@@ -844,39 +947,44 @@ def _chunked_aggregate(stmt, chunks, exprs, out_names, group_exprs,
             order_exprs[col] = item.expr
         ascending.append(not item.descending)
 
+    def _vec_count_lookup(d: dict) -> pd.Series:
+        """{group tuple: count} -> Series aligned to merged's rows:
+        normalize NaN group-key slots to the string fill exactly like
+        _norm_key, then reindex."""
+        if not gcols:
+            return pd.Series([d.get((), 0)] * len(merged),
+                             index=merged.index)
+        mi = pd.MultiIndex.from_frame(
+            pd.DataFrame({c: _norm_gcol(merged[c]) for c in gcols}))
+        if d:
+            lut = pd.Series(list(d.values()),
+                            index=pd.MultiIndex.from_tuples(d))
+            vals = lut.reindex(mi).fillna(0).astype("int64")
+        else:
+            vals = pd.Series(0, index=mi)
+        return pd.Series(vals.to_numpy(), index=merged.index)
+
     def vec_merged(e) -> pd.Series:
         """Vectorized ev_merged over the whole merged frame — the emit
         is O(groups) and a per-row Python loop dominates at-scale
         fallback time (200k groups ≈ seconds)."""
         if isinstance(e, Lit):
             return pd.Series([e.value] * len(merged), index=merged.index)
+        if isinstance(e, FuncCall) and e.name in _THETA_SET_FNS:
+            return _vec_count_lookup(_setop_count_dict(e)).astype(float)
+        if isinstance(e, FuncCall) and e.name == "theta_sketch_estimate" \
+                and len(e.args) == 1:
+            a, is_setop = _estimate_arg(e)
+            if is_setop:
+                return _vec_count_lookup(_setop_count_dict(a)) \
+                    .astype(float)
+            return vec_merged(a).astype(float)
         if isinstance(e, FuncCall) and e.name in AGG_FUNCS:
             k = _k(e)
             inner, cond = _unwrap(e)
             if inner.name in ("count_distinct", "approx_count_distinct",
                               "theta_sketch"):
-                d = dcounts[k]
-                if not gcols:
-                    return pd.Series([d.get((), 0)] * len(merged),
-                                     index=merged.index)
-                # vectorized lookup: normalize NaN group-key slots to the
-                # string fill exactly like _norm_key, then reindex
-                nf = {}
-                for c in gcols:
-                    s = merged[c]
-                    if not (s.dtype == object
-                            or str(s.dtype).startswith(("str",
-                                                        "category"))):
-                        s = s.astype(object).where(s.notna(), _FILL)
-                    nf[c] = s
-                mi = pd.MultiIndex.from_frame(pd.DataFrame(nf))
-                if d:
-                    lut = pd.Series(list(d.values()),
-                                    index=pd.MultiIndex.from_tuples(d))
-                    vals = lut.reindex(mi).fillna(0).astype("int64")
-                else:
-                    vals = pd.Series(0, index=mi)
-                return pd.Series(vals.to_numpy(), index=merged.index)
+                return _vec_count_lookup(dcounts[k])
             if inner.name == "count" and not inner.args:
                 s = merged[spec_col[k]] if cond is not None \
                     else merged["__rows"]

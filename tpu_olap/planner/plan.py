@@ -668,6 +668,8 @@ class _Rewriter:
             self.aggs.append(CardinalityAggregation(name, tuple(cols),
                                                     by_row=len(cols) > 1))
         elif fn == "theta_sketch":
+            if len(e.args) != 1:
+                raise RewriteError("theta_sketch takes one column")
             col = self._filter_col(e.args[0])
             self.aggs.append(ThetaSketchAggregation(name, col))
         elif fn == "avg":
