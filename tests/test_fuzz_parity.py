@@ -64,6 +64,10 @@ _AGGS = [
     ("sum(CASE WHEN qty > 25 THEN qty ELSE 0 END)", "scw"),
     ("sum(CAST(price AS INT))", "sci"),
     ("max(CAST(qty AS DOUBLE))", "xcd"),
+    # standard-SQL FILTER aggregates (round 3)
+    ("sum(qty) FILTER (WHERE region = 'west')", "sfw"),
+    ("count(*) FILTER (WHERE price > 500.5)", "cfp"),
+    ("avg(price) FILTER (WHERE small IN (1, 2))", "afs"),
 ]
 _FILTERS = [
     "qty > 25", "qty BETWEEN -10 AND 80", "price < 500.5",
