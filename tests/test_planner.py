@@ -121,7 +121,7 @@ def test_avg_becomes_postagg():
         "SELECT avg(lo_quantity) AS aq FROM lineorder")
     assert plan.rewritten
     q = plan.query
-    assert q.post_aggregations[0].to_json()["fn"] == "/"
+    assert q.post_aggregations[0].to_json()["fn"] == "quotient"
     assert {a.to_json()["type"] for a in q.aggregations} == \
         {"longSum", "count"}
 

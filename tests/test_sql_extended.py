@@ -575,3 +575,19 @@ def test_concat_and_trim_fallback():
     assert got["n"].tolist() == want.tolist()
     got = eng.sql("SELECT count(*) AS n FROM t WHERE trim(g) = 'a'")
     assert got["n"][0] == int((df.g == "a").sum())
+
+
+def test_global_avg_over_zero_rows_is_null():
+    """A global aggregate emits its one row even when no rows match;
+    AVG of nothing is NULL on both paths (fuzz seed 664: the device's
+    x/0 -> 0 arithmetic rule said 0.0)."""
+    from tpu_olap.planner.fallback import execute_fallback
+    eng, _ = _engine()
+    sql = ("SELECT sum(v) AS s, avg(v) AS a FROM t "
+           "WHERE g = 'a' AND g = 'b'")  # contradictory: zero rows
+    dev = eng.sql(sql)
+    assert eng.last_plan.rewritten
+    assert int(dev["s"][0]) == 0 and pd.isna(dev["a"][0])
+    fb = execute_fallback(eng.planner.plan(sql).stmt, eng.catalog,
+                          eng.config)
+    assert pd.isna(fb["a"][0])

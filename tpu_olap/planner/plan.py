@@ -678,8 +678,14 @@ class _Rewriter:
             c = next(self._names)
             self.aggs.append(SumAggregation(s, fieldn, vt))
             self.aggs.append(CountAggregation(c))
+            # "quotient": a GLOBAL aggregate over zero matching rows
+            # still emits its one row, and AVG of nothing is NULL per
+            # SQL — the "/" post-agg's x/0 -> 0 rule would say 0
+            # (grouped rows always have count >= 1, so no difference
+            # there; found by fuzz seed 664)
             self.postaggs.append(ArithmeticPostAgg(
-                name, "/", (FieldAccessPostAgg(s), FieldAccessPostAgg(c))))
+                name, "quotient",
+                (FieldAccessPostAgg(s), FieldAccessPostAgg(c))))
         elif fn == "agg_filter":
             # standard-SQL `agg(...) FILTER (WHERE cond)` -> the IR's
             # FilteredAggregation (SURVEY.md §3.3 "filtered aggregator")
