@@ -92,8 +92,8 @@ def test_pallas_kernel_is_active():
 
 def test_pallas_ineligible_falls_back():
     _, forced = _engines()
-    # avg -> sum over a DOUBLE-typed virtual division? use min: not a sum
-    q = "SELECT color, min(price) AS m FROM t GROUP BY color"
+    # division makes the sum input DOUBLE-typed: outside the int32 kernel
+    q = "SELECT color, sum(price / 2) AS m FROM t GROUP BY color"
     plan = forced.planner.plan(q)
     phys = lower(plan.query, plan.entry.segments, forced.config)
     assert phys.pallas_reason is not None
@@ -101,6 +101,29 @@ def test_pallas_ineligible_falls_back():
     # still correct via the generic kernel
     plain, _ = _engines()
     pd.testing.assert_frame_equal(plain.sql(q), forced.sql(q))
+
+
+MINMAX_QUERIES = [
+    # min/max ride a second VPU-accumulated output buffer (round 3);
+    # max rides negated so one minimum-accumulate serves both
+    """SELECT color, min(price) AS mn, max(price) AS mx, sum(price) AS s
+       FROM t GROUP BY color ORDER BY color""",
+    # with filters, a nullable input, and a filtered aggregator
+    """SELECT region, min(qty) AS mn, max(qty) AS mx,
+              min(price) FILTER (WHERE qty > 25) AS mf, count(*) AS n
+       FROM t WHERE price < 8000 GROUP BY region ORDER BY region""",
+    # global (single group): empty-filter max must render NULL
+    """SELECT max(price) FILTER (WHERE qty > 9999) AS none_mx,
+       min(price) AS mn FROM t""",
+    # negative-capable expression input
+    """SELECT color, min(price - 5000) AS mn, max(price - 5000) AS mx
+       FROM t GROUP BY color ORDER BY color""",
+]
+
+
+@pytest.mark.parametrize("sql", MINMAX_QUERIES)
+def test_pallas_minmax_parity(sql):
+    _assert_parity(sql, check_eligible=True)
 
 
 def test_pallas_group_cap_guard():

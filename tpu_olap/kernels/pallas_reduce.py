@@ -284,23 +284,36 @@ class PallasLayout:
     #                               bias) — bias < 0 means inputs are
     #                               shifted by -bias into [0, hi-lo] and an
     #                               extra per-agg row-count column sits at
-    #                               start + n_planes for the un-shift
+    #                               start + n_planes for the un-shift.
+    #                               min/max aggs use `start` for their
+    #                               non-null COUNT column (riding the
+    #                               matmul) and n_planes as the column
+    #                               index into the second (VPU min)
+    #                               output buffer
+    n_minmax: int = 0             # columns of the second output buffer
 
 
 def plan_layout(agg_plans, sum_bounds) -> PallasLayout:
     slots = []
     h = 1  # slot 0: _rows
+    n_mm = 0
     for p in agg_plans:
         if p.kind == "count":
             slots.append((p.name, "count", h, 1, 0))
             h += 1
+        elif p.kind in ("min", "max"):
+            # non-null count column in the matmul buffer + one column in
+            # the min-accumulated VPU buffer (max rides negated)
+            slots.append((p.name, p.kind, h, n_mm, 0))
+            h += 1
+            n_mm += 1
         else:  # sum
             n = -(-32 // N_PLANE_BITS)
             lo = sum_bounds[p.name][0]
             bias = lo if lo < 0 else 0
             slots.append((p.name, "sum", h, n, bias))
             h += n + (1 if bias else 0)
-    return PallasLayout(h, 0, tuple(slots))
+    return PallasLayout(h, 0, tuple(slots), n_minmax=n_mm)
 
 
 def eligible(query, plan, table, config, filter_fn=None) -> str | None:
@@ -347,9 +360,9 @@ def eligible(query, plan, table, config, filter_fn=None) -> str | None:
             return f"aggregator {p.name!r} has a non-simple filter"
         if p.kind == "count":
             continue
-        if p.kind != "sum":
+        if p.kind not in ("sum", "min", "max"):
             return f"aggregation kind {p.kind!r}"
-        if np.dtype(p.acc_dtype).kind != "i":
+        if p.kind == "sum" and np.dtype(p.acc_dtype).kind != "i":
             return f"non-integer sum {p.name!r}"
         f = p.fields[0]
         if f in plan.virtual_exprs:
@@ -357,8 +370,8 @@ def eligible(query, plan, table, config, filter_fn=None) -> str | None:
         else:
             b = bounds.get(f)
         if b is None:
-            return f"cannot bound sum input {f!r}"
-        if b[1] - b[0] > MAX_VALUE:
+            return f"cannot bound {p.kind} input {f!r}"
+        if p.kind == "sum" and b[1] - b[0] > MAX_VALUE:
             return f"sum input {f!r} span {b} exceeds int32"
 
     for name in traced_const_names(plan, table, filter_fn):
@@ -405,12 +418,17 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
 
     const_names = traced_const_names(plan, table, filter_fn)
     col_names = [c for c in kernel_columns(plan) if c != TIME_COLUMN]
+    n_mm = layout.n_minmax
+    MM_pad = max(128, -(-n_mm // 128) * 128) if n_mm else 0
 
     def make_kernel_fn(null_names):
         def kernel_fn(*refs):
             (col_refs, pre_refs, null_refs, valid_ref, const_refs,
-             out_ref) = _split_refs(refs, len(col_names), n_pre,
-                                    len(null_names), len(const_names))
+             outs) = _split_refs(refs, len(col_names), n_pre,
+                                 len(null_names), len(const_names),
+                                 n_outs=2 if n_mm else 1)
+            out_ref = outs[0]
+            mm_ref = outs[1] if n_mm else None
             kb = pl.program_id(0)
             step = pl.program_id(1)
 
@@ -459,6 +477,7 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
 
             # value planes [H_pad, rb]
             rows = [mask.astype(jnp.bfloat16)[None, :]]
+            mm_cols = []
             for p, (name, kind, start, n_planes, bias) in zip(
                     agg_plans, layout.agg_slots):
                 m = mask if p.filter_fn is None else \
@@ -471,6 +490,17 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
                 nm = env["nulls"].get(f)
                 if nm is not None:
                     m = m & ~nm
+                if kind in ("min", "max"):
+                    # non-null count rides the matmul; the value is a
+                    # masked VPU min over this K-block (max rides
+                    # NEGATED so one minimum-accumulate serves both)
+                    rows.append(m.astype(jnp.bfloat16)[None, :])
+                    vv = -v if kind == "max" else v
+                    sel = (kk == key[None, :]) & m[None, :]
+                    mm_cols.append(jnp.min(
+                        jnp.where(sel, vv[None, :], jnp.int32(MAX_VALUE)),
+                        axis=1))
+                    continue
                 if bias:
                     v = v - jnp.int32(bias)  # shift into [0, hi-lo]
                 # strongly-typed zero: under x64 a Python 0 enters the
@@ -496,6 +526,21 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
             def _():
                 out_ref[:, :] = jnp.zeros((KB, H_pad), jnp.int32)
             out_ref[:, :] += partial
+
+            if mm_ref is not None:
+                pad = MM_pad - len(mm_cols)
+                cols2 = [c[:, None] for c in mm_cols]
+                if pad:
+                    cols2.append(jnp.full((KB, pad), jnp.int32(MAX_VALUE),
+                                          jnp.int32))
+                upd = jnp.concatenate(cols2, axis=1)
+
+                @pl.when(step == 0)
+                def _():
+                    mm_ref[:, :] = jnp.full((KB, MM_pad),
+                                            jnp.int32(MAX_VALUE),
+                                            jnp.int32)
+                mm_ref[:, :] = jnp.minimum(mm_ref[:, :], upd)
         return kernel_fn
 
     # index maps return strongly-typed int32 zeros: under x64 a literal 0
@@ -539,6 +584,13 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
         const_in = [_narrow(jnp.asarray(consts[c]).reshape(1, -1), jnp)
                     for c in const_names]
 
+        out_specs = pl.BlockSpec((KB, H_pad), lambda kb, i: (kb, _z))
+        out_shape = jax.ShapeDtypeStruct((K_pad, H_pad), jnp.int32)
+        if n_mm:
+            out_specs = [out_specs,
+                         pl.BlockSpec((KB, MM_pad), lambda kb, i: (kb, _z))]
+            out_shape = [out_shape,
+                         jax.ShapeDtypeStruct((K_pad, MM_pad), jnp.int32)]
         out = pl.pallas_call(
             make_kernel_fn(null_names),
             grid=(n_kb, grid_rows),
@@ -547,10 +599,14 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
                       + [row_spec() for _ in null_in]
                       + [row_spec()]
                       + [const_spec(c.shape[1]) for c in const_in]),
-            out_specs=pl.BlockSpec((KB, H_pad), lambda kb, i: (kb, _z)),
-            out_shape=jax.ShapeDtypeStruct((K_pad, H_pad), jnp.int32),
+            out_specs=out_specs,
+            out_shape=out_shape,
             interpret=interpret,
         )(*col_in, *pre_in, *null_in, mask2, *const_in)
+        mm = None
+        if n_mm:
+            out, mm = out
+            mm = mm[:K]
         out = out[:K]
 
         res = {"_rows": out[:, layout.rows_slot].astype(jnp.int64)}
@@ -558,6 +614,14 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
                                                           layout.agg_slots):
             if kind == "count":
                 res[name] = out[:, start].astype(p.acc_dtype)
+            elif kind in ("min", "max"):
+                v = mm[:, n_planes]  # n_planes doubles as the mm column
+                if kind == "max":
+                    v = -v
+                # empty groups carry the identity; finalize renders them
+                # NULL via the non-null count
+                res[name] = v.astype(p.acc_dtype)
+                res[f"_nn_{name}"] = out[:, start].astype(jnp.int32)
             else:
                 # Plane recombination rides f64, NOT int64 shifts: on the
                 # v5e sandbox, a jit-fused  custom_call -> convert(i64) ->
@@ -595,10 +659,11 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
     return fn
 
 
-def _split_refs(refs, n_cols, n_pre, n_nulls, n_consts):
+def _split_refs(refs, n_cols, n_pre, n_nulls, n_consts, n_outs=1):
     """n_pre: host-precomputed int32 id streams — the granularity bucket
     (if any) followed by one stream per gather-needing dimension
-    (remap/timeformat), in dimension order."""
+    (remap/timeformat), in dimension order. n_outs: trailing output refs
+    (the matmul accumulator, plus the min/max buffer when present)."""
     refs = list(refs)
     cols = refs[:n_cols]
     pre = refs[n_cols:n_cols + n_pre]
@@ -606,8 +671,8 @@ def _split_refs(refs, n_cols, n_pre, n_nulls, n_consts):
     valid = refs[n_cols + n_pre + n_nulls]
     consts = refs[n_cols + n_pre + n_nulls + 1:
                   n_cols + n_pre + n_nulls + 1 + n_consts]
-    out = refs[-1]
-    return cols, pre, nulls, valid, consts, out
+    outs = refs[-n_outs:]
+    return cols, pre, nulls, valid, consts, outs
 
 
 def _narrow(x, jnp):
