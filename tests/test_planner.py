@@ -391,3 +391,35 @@ def test_virtual_numeric_dim_with_nulls():
         fb = execute_fallback(eng.planner.plan(sql).stmt, eng.catalog,
                               eng.config)
         assert_frame_parity(dev, fb, ordered=True)
+
+
+def test_having_over_time_bucket_group():
+    """GROUP BY date_trunc(...) HAVING ... must not lower to a
+    timeseries query (which has no having clause — the filter would be
+    silently dropped; fuzz seed 1300)."""
+    import numpy as np
+    import pandas as pd
+
+    from tpu_olap import Engine
+    from tpu_olap.bench.parity import assert_frame_parity
+    from tpu_olap.planner.fallback import execute_fallback
+    rng = np.random.default_rng(9)
+    n = 3000
+    df = pd.DataFrame({
+        "ts": pd.to_datetime("2024-01-01")
+        + pd.to_timedelta(rng.integers(0, 86400 * 40, n), unit="s"),
+        "v": rng.integers(0, 100, n).astype(np.int64),
+    })
+    eng = Engine()
+    eng.register_table("t", df, time_column="ts")
+    # the filtered sum is 0 for most days, so a dropped HAVING is visible
+    sql = ("SELECT date_trunc('day', ts) AS d, "
+           "sum(v) FILTER (WHERE v > 98) AS hi FROM t "
+           "GROUP BY date_trunc('day', ts) HAVING hi > 0")
+    dev = eng.sql(sql)
+    assert eng.last_plan.rewritten
+    assert eng.planner.plan(sql).query.query_type == "groupBy"
+    assert (dev["hi"] > 0).all()
+    fb = execute_fallback(eng.planner.plan(sql).stmt, eng.catalog,
+                          eng.config)
+    assert_frame_parity(dev, fb)

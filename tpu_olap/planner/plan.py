@@ -950,6 +950,14 @@ class _Rewriter:
             context=(("skipEmptyBuckets",
                       not isinstance(granularity, AllGranularity)),),
         )
+        if not dims and having_spec is not None and \
+                isinstance(granularity, AllGranularity):
+            # a GLOBAL aggregate emits its one row even over empty input,
+            # and HAVING then filters that row — the groupBy assembler
+            # drops empty groups, and timeseries has no having clause,
+            # so neither device shape preserves the semantics
+            raise RewriteError(
+                "global aggregate with HAVING executes on the fallback")
         if topn is not None and having_spec is None:
             metric, threshold, inverted = topn
             query = TopNQuerySpec(
@@ -957,7 +965,11 @@ class _Rewriter:
                 inverted=inverted, granularity=granularity,
                 aggregations=tuple(self.aggs),
                 post_aggregations=tuple(self.postaggs), **common)
-        elif not dims and limit_spec is None:
+        elif not dims and limit_spec is None and having_spec is None:
+            # HAVING forces the GroupBy shape: Druid's timeseries query
+            # has no having clause, so lowering one here would silently
+            # drop the filter (found by fuzz seed 1300 — a HAVING over a
+            # rarely-zero aggregate made the drop visible)
             query = TimeseriesQuerySpec(
                 granularity=granularity, aggregations=tuple(self.aggs),
                 post_aggregations=tuple(self.postaggs), **common)
