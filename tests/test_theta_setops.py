@@ -216,3 +216,35 @@ def test_sql_theta_setop_bad_arg_falls_back(setup):
         eng.sql("SELECT theta_sketch_intersect(sum(user), "
                 "theta_sketch(user)) AS x FROM events")
     assert not eng.last_plan.rewritten
+
+
+def test_sql_theta_setop_multichip():
+    """Set ops over raw sketch tables merged across an 8-device mesh:
+    the unpacked raw-table path composes with the theta_merge
+    collective; sparse sets keep the oracle discriminating."""
+    import numpy as np
+
+    from tpu_olap.executor import EngineConfig
+    rng = np.random.default_rng(3)
+    n = 20_000
+    df = pd.DataFrame({
+        "ts": pd.to_datetime("2024-05-01")
+        + pd.to_timedelta(rng.integers(0, 86400 * 30, n), unit="s"),
+        "user": rng.integers(0, 30_000, n),
+        "act": rng.choice(["b", "v"], n),
+        "dev": rng.choice(["x", "y"], n),
+    })
+    eng = Engine(EngineConfig(num_shards=8,
+                              fallback_on_device_failure=False))
+    eng.register_table("ev", df, time_column="ts", block_rows=512)
+    got = eng.sql(
+        "SELECT dev, theta_sketch_intersect("
+        "theta_sketch(user) FILTER (WHERE act = 'b'), "
+        "theta_sketch(user) FILTER (WHERE act = 'v')) AS both_u "
+        "FROM ev GROUP BY dev ORDER BY dev")
+    assert eng.last_plan.rewritten, eng.last_plan.fallback_reason
+    for _, r in got.iterrows():
+        sub = df[df.dev == r["dev"]]
+        want = len(set(sub[sub.act == "b"].user)
+                   & set(sub[sub.act == "v"].user))
+        assert int(r["both_u"]) == want
