@@ -591,3 +591,49 @@ def test_global_avg_over_zero_rows_is_null():
     fb = execute_fallback(eng.planner.plan(sql).stmt, eng.catalog,
                           eng.config)
     assert pd.isna(fb["a"][0])
+
+
+def test_extraction_in_filter_rewrites():
+    """upper()/substr() IN (...) lowers to an OR of extraction selector
+    filters on the device path (was fallback-only)."""
+    from tpu_olap.planner.fallback import execute_fallback
+    eng, df = _engine()
+    for sql, oracle in (
+        ("SELECT count(*) AS n FROM t WHERE upper(g) IN ('A', 'B')",
+         int(df.g.str.upper().isin(["A", "B"]).sum())),
+        ("SELECT count(*) AS n FROM t WHERE substr(city, 1, 2) IN ('c0',"
+         " 'c3')",
+         int(df.city.str[:2].isin(["c0", "c3"]).sum())),
+        ("SELECT count(*) AS n FROM t WHERE NOT (upper(g) IN ('A', 'Z'))",
+         int((~df.g.str.upper().isin(["A", "Z"])).sum())),
+    ):
+        r = eng.sql(sql)
+        assert eng.last_plan.rewritten, eng.last_plan.fallback_reason
+        assert int(r["n"][0]) == oracle, sql
+        fb = execute_fallback(eng.planner.plan(sql).stmt, eng.catalog,
+                              eng.config)
+        assert int(fb["n"][0]) == oracle
+
+
+def test_extraction_in_filter_null_semantics():
+    """NULL in an extraction IN list matches null rows identically on
+    both paths (ex(null) is null; mirrors the plain-column in filter)."""
+    from tpu_olap.planner.fallback import execute_fallback
+    eng = Engine()
+    rng = np.random.default_rng(0)
+    n = 2000
+    df2 = pd.DataFrame({
+        "ts": pd.to_datetime("2024-01-01"),
+        "g": rng.choice(["a", "B", "c", None], n),
+        "v": rng.integers(0, 100, n),
+    })
+    eng.register_table("t2", df2, time_column="ts")
+    for sql in (
+        "SELECT count(*) AS n FROM t2 WHERE upper(g) IN ('A', NULL)",
+        "SELECT count(*) AS n FROM t2 WHERE upper(g) IN ('A', 'C')",
+    ):
+        r = eng.sql(sql)
+        assert eng.last_plan.rewritten
+        fb = execute_fallback(eng.planner.plan(sql).stmt, eng.catalog,
+                              eng.config)
+        assert int(r["n"][0]) == int(fb["n"][0]), sql

@@ -175,7 +175,9 @@ def _filter_value_sets(filter_spec) -> dict:
         if isinstance(c, F.SelectorFilter) and c.extraction_fn is None \
                 and c.value is not None:
             col, vs = c.dimension, {c.value}
-        elif isinstance(c, F.InFilter):
+        elif isinstance(c, F.InFilter) and c.extraction_fn is None:
+            # extraction-IN values are post-extraction strings, NOT raw
+            # column values — they must not restrict the dim domain
             col = c.dimension
             vs = {v for v in c.values if v is not None}
         elif isinstance(c, F.OrFilter):

@@ -26,7 +26,8 @@ def _reject_extraction_fn(d: dict, kind: str) -> None:
     if d.get("extractionFn") is not None:
         raise ValueError(
             f"extractionFn on {kind!r} filter is not supported "
-            "(supported on 'selector'); rewrite via a virtual column")
+            "(supported on 'selector' and 'in'); rewrite via a virtual "
+            "column or an extraction IN list")
 
 
 @register("filter", "selector")
@@ -56,17 +57,22 @@ class SelectorFilter(FilterSpec):
 class InFilter(FilterSpec):
     dimension: str
     values: tuple
+    extraction_fn: object = None  # ExtractionFunctionSpec | None
 
     def columns(self):
         return {self.dimension}
 
     def to_json(self):
-        return {"type": "in", "dimension": self.dimension, "values": list(self.values)}
+        out = {"type": "in", "dimension": self.dimension,
+               "values": list(self.values)}
+        if self.extraction_fn is not None:
+            out["extractionFn"] = self.extraction_fn.to_json()
+        return out
 
     @staticmethod
     def from_json(d):
-        _reject_extraction_fn(d, "in")
-        return InFilter(d["dimension"], tuple(d["values"]))
+        ef = from_json("extractionFn", d.get("extractionFn"))
+        return InFilter(d["dimension"], tuple(d["values"]), ef)
 
 
 @register("filter", "bound")

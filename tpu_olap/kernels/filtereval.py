@@ -205,6 +205,19 @@ def compile_filter(spec, table, pool: ConstPool, virtual_exprs=None):
 
     def _in(s, typ):
         col = s.dimension
+        if s.extraction_fn is not None:
+            if typ is not ColumnType.STRING:
+                raise UnsupportedFilter(
+                    f"extractionFn in filter on non-string column {col!r}")
+            d = table.dictionaries[col]
+            ex = _extraction_callable(s.extraction_fn)
+            vset = set(s.values)
+            tbl = d.predicate_table(lambda v: ex(v) in vset)
+            # null rows match iff the list carries null (ex(null) is
+            # null, mirroring the fallback's `... | isna()` semantics)
+            tbl[0] = None in vset
+            cname = pool.add(tbl)
+            return lambda env, c: c[cname][env["cols"][col]]
         if typ is ColumnType.STRING:
             d = table.dictionaries[col]
             cname = pool.add(d.in_table(s.values))

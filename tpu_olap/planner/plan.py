@@ -479,12 +479,19 @@ class _Rewriter:
             col = self._filter_col(e.args[0])
             return F.SelectorFilter(col, None)
         if isinstance(e, FuncCall) and e.name == "in_list":
-            col = self._filter_col(e.args[0])
             vals = []
             for a in e.args[1:]:
                 if not isinstance(a, Lit):
                     raise RewriteError("non-literal IN list")
                 vals.append(a.value)
+            if not isinstance(e.args[0], Col):
+                # extraction IN: upper(g) IN (...) -> in filter with an
+                # extractionFn (one predicate table, one device gather)
+                ext = self._extraction_of(e.args[0])
+                if ext is not None:
+                    col, fn = ext
+                    return F.InFilter(col, tuple(vals), fn)
+            col = self._filter_col(e.args[0])
             return F.InFilter(col, tuple(vals))
         if isinstance(e, FuncCall) and e.name == "like":
             col = self._filter_col(e.args[0])
