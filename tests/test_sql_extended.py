@@ -637,3 +637,26 @@ def test_extraction_in_filter_null_semantics():
         fb = execute_fallback(eng.planner.plan(sql).stmt, eng.catalog,
                               eng.config)
         assert int(r["n"][0]) == int(fb["n"][0]), sql
+
+
+def test_extraction_bound_filter_rewrites():
+    """Range comparisons over extractions (substr/upper BETWEEN/</>)
+    lower to bound filters with an extractionFn — one predicate table,
+    lexicographic over the extracted strings."""
+    from tpu_olap.planner.fallback import execute_fallback
+    eng, df = _engine()
+    for sql, oracle in (
+        ("SELECT count(*) AS n FROM t WHERE substr(city, 1, 2) "
+         "BETWEEN 'c1' AND 'c4'",
+         int(df.city.str[:2].between("c1", "c4").sum())),
+        ("SELECT count(*) AS n FROM t WHERE upper(g) >= 'C'",
+         int((df.g.str.upper() >= "c".upper()).sum())),
+        ("SELECT count(*) AS n FROM t WHERE substr(city, 2, 1) < '3'",
+         int((df.city.str[1:2] < "3").sum())),
+    ):
+        r = eng.sql(sql)
+        assert eng.last_plan.rewritten, eng.last_plan.fallback_reason
+        assert int(r["n"][0]) == oracle, sql
+        fb = execute_fallback(eng.planner.plan(sql).stmt, eng.catalog,
+                              eng.config)
+        assert int(fb["n"][0]) == oracle

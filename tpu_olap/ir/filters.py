@@ -26,8 +26,8 @@ def _reject_extraction_fn(d: dict, kind: str) -> None:
     if d.get("extractionFn") is not None:
         raise ValueError(
             f"extractionFn on {kind!r} filter is not supported "
-            "(supported on 'selector' and 'in'); rewrite via a virtual "
-            "column or an extraction IN list")
+            "(supported on 'selector', 'in', and 'bound'); rewrite via a "
+            "virtual column or an extraction filter")
 
 
 @register("filter", "selector")
@@ -84,6 +84,7 @@ class BoundFilter(FilterSpec):
     lower_strict: bool = False
     upper_strict: bool = False
     ordering: str = "lexicographic"  # or "numeric"
+    extraction_fn: object = None     # ExtractionFunctionSpec | None
 
     def columns(self):
         return {self.dimension}
@@ -97,15 +98,17 @@ class BoundFilter(FilterSpec):
         if self.upper is not None:
             d["upper"] = self.upper
             d["upperStrict"] = self.upper_strict
+        if self.extraction_fn is not None:
+            d["extractionFn"] = self.extraction_fn.to_json()
         return d
 
     @staticmethod
     def from_json(d):
-        _reject_extraction_fn(d, "bound")
+        ef = from_json("extractionFn", d.get("extractionFn"))
         return BoundFilter(d["dimension"], d.get("lower"), d.get("upper"),
                            bool(d.get("lowerStrict", False)),
                            bool(d.get("upperStrict", False)),
-                           d.get("ordering", "lexicographic"))
+                           d.get("ordering", "lexicographic"), ef)
 
 
 @register("filter", "regex")

@@ -162,6 +162,36 @@ def compile_filter(spec, table, pool: ConstPool, virtual_exprs=None):
 
     def _bound(s, typ):
         col = s.dimension
+        if s.extraction_fn is not None:
+            if typ is not ColumnType.STRING:
+                raise UnsupportedFilter(
+                    f"extractionFn bound on non-string column {col!r}")
+            if s.ordering == "numeric":
+                raise UnsupportedFilter(
+                    "extractionFn bound supports lexicographic ordering "
+                    "only (extracted values are strings)")
+            for b in (s.lower, s.upper):
+                if b is not None and not isinstance(b, str):
+                    raise UnsupportedFilter(
+                        f"extractionFn bound needs string bounds, got "
+                        f"{b!r}")
+            d = table.dictionaries[col]
+            ex = _extraction_callable(s.extraction_fn)
+
+            def in_range(v):
+                e = ex(v)
+                if e is None:
+                    return False
+                if s.lower is not None and (
+                        e < s.lower or (s.lower_strict and e == s.lower)):
+                    return False
+                if s.upper is not None and (
+                        e > s.upper or (s.upper_strict and e == s.upper)):
+                    return False
+                return True
+
+            cname = pool.add(d.predicate_table(in_range))
+            return lambda env, c: c[cname][env["cols"][col]]
         if s.ordering == "numeric" or typ is not ColumnType.STRING \
                 or col == TIME_COLUMN:
             if typ is ColumnType.STRING:

@@ -513,6 +513,20 @@ class _Rewriter:
                     col, fn = ext
                     f = F.SelectorFilter(col, right.value, fn)
                     return F.NotFilter(f) if op == "!=" else f
+            if isinstance(right, Lit) and isinstance(right.value, str) \
+                    and op in ("<", "<=", ">", ">="):
+                # range over an extraction: substr(c, 1, 2) BETWEEN ...
+                ext = self._extraction_of(left)
+                if ext is not None:
+                    col, fn = ext
+                    v = right.value
+                    if op in ("<", "<="):
+                        return F.BoundFilter(
+                            col, upper=v, upper_strict=(op == "<"),
+                            extraction_fn=fn)
+                    return F.BoundFilter(
+                        col, lower=v, lower_strict=(op == ">"),
+                        extraction_fn=fn)
             if isinstance(left, Col) and isinstance(right, Lit):
                 col = self._check_col(left.name)
                 v = right.value
