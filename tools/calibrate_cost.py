@@ -20,6 +20,13 @@ prefers these over the coarse built-ins. Run:
 
     python tools/calibrate_cost.py            # default backend
     CAL_FORCE_CPU=1 python tools/calibrate_cost.py   # 8-dev CPU mesh
+
+On a single-chip backend (the tunnel exposes one TPU) only the scan
+slope is measurable — there is no ICI to fit merge/latency against — so
+the script writes just `scan_ns_per_row_col` (+ a single-device dispatch
+floor) and `constants()` falls back per-key for the rest. Set
+CAL_REQUIRE_TPU=1 to exit(3) instead of writing when jax resolves to CPU
+(the probe uses this so a closed tunnel cannot bank a CPU fit as "tpu").
 """
 
 import json
@@ -64,6 +71,40 @@ def _register(eng, rows, k):
 SQL = "SELECT g, sum(v) AS s FROM t GROUP BY g"
 
 
+def _write(backend, fitted, cost_mod):
+    path = os.path.join(REPO, "tpu_olap", "planner",
+                        "cost_calibration.json")
+    data = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            data = json.load(f)
+    data[backend] = fitted
+    with open(path, "w") as f:
+        json.dump(data, f, indent=1)
+    cost_mod._calibration_cache = None
+    print(json.dumps({"backend": backend, **fitted}))
+
+
+def _calibrate_single_device(backend, cost_mod):
+    """One chip: fit the scan slope (the constant the SF100 projection
+    runs on) from the rows axis; merge/lat/gspmd stay per-key fallbacks
+    because there is no second device to move bytes to."""
+    rows_a, rows_b, k0 = 1 << 19, 1 << 21, 8
+    ta = _time_point(rows_a, k0, None)
+    tb = _time_point(rows_b, k0, None)
+    n_cols = 2
+    scan = max(0.001, (tb - ta) * 1000.0 / ((rows_b - rows_a) * n_cols))
+    fitted = {
+        "scan_ns_per_row_col": round(float(scan), 5),
+        "dispatch_floor_us": round(float(max(0.0, ta - rows_a * n_cols
+                                             * scan / 1000.0)), 1),
+        "fitted_shards": 1,
+        "fitted_iters": ITERS,
+        "note": "single-device fit; merge/lat/gspmd left to fallbacks",
+    }
+    _write(backend, fitted, cost_mod)
+
+
 def _time_point(rows, k, strategy):
     eng = _make_engine(strategy)
     _register(eng, rows, k)
@@ -78,15 +119,24 @@ def _time_point(rows, k, strategy):
 
 
 def main():
+    global SHARDS
     if env_flag("CAL_FORCE_CPU"):
         ensure_host_device_count(SHARDS)
         force_cpu_platform()
     import jax
     backend = jax.default_backend()
-    if jax.device_count() < SHARDS:
+    if backend == "cpu" and env_flag("CAL_REQUIRE_TPU"):
+        print("backend is cpu; CAL_REQUIRE_TPU set — not writing",
+              file=sys.stderr)
+        sys.exit(3)
+    if backend == "cpu" and jax.device_count() < SHARDS:
         ensure_host_device_count(SHARDS)
+    SHARDS = min(SHARDS, jax.device_count(),
+                 int(os.environ.get("CAL_SHARDS", SHARDS)))
     from tpu_olap.planner import cost as cost_mod
-    hops = 3  # ceil(log2(8))
+    if SHARDS < 2:
+        return _calibrate_single_device(backend, cost_mod)
+    hops = max(1, int(np.ceil(np.log2(SHARDS))))
 
     # --- scan slope: tiny K, two row counts; historicals ---------------
     rows_a, rows_b, k0 = 1 << 17, 1 << 19, 8
@@ -123,17 +173,7 @@ def main():
         "fitted_shards": SHARDS,
         "fitted_iters": ITERS,
     }
-    path = os.path.join(REPO, "tpu_olap", "planner",
-                        "cost_calibration.json")
-    data = {}
-    if os.path.exists(path):
-        with open(path) as f:
-            data = json.load(f)
-    data[backend] = fitted
-    with open(path, "w") as f:
-        json.dump(data, f, indent=1)
-    cost_mod._calibration_cache = None
-    print(json.dumps({"backend": backend, **fitted}))
+    _write(backend, fitted, cost_mod)
 
 
 if __name__ == "__main__":

@@ -84,15 +84,73 @@ def tunnel_alive(timeout_s: float = 120) -> bool:
         return False
 
 
-# Extra measurements banked opportunistically after the headline: the
-# XLA-scatter leg of the Pallas comparison (the banked auto run IS the
-# Pallas leg: on TPU, auto uses the kernel for every eligible plan), and
-# the SF10 scale proof (dataset should be pre-generated under .ssb_data
-# so the up-window is spent ingesting + querying, not writing parquet).
+def attempt_cmd(argv, extra_env=None, timeout=None):
+    """Run a tool subprocess on the live backend; the tool itself is
+    responsible for refusing to bank CPU runs (exit 3). Returns status in
+    {"ok", "refused-cpu", "timeout", "error"}."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.update(extra_env or {})
+    try:
+        proc = subprocess.run(
+            [sys.executable] + argv,
+            timeout=timeout or ATTEMPT_TIMEOUT, capture_output=True,
+            text=True, env=env, cwd=REPO)
+    except subprocess.TimeoutExpired as e:
+        s = e.stderr or b""
+        s = s if isinstance(s, str) else s.decode(errors="replace")
+        return "timeout", {"stderr": s[-500:]}
+    if proc.returncode == 3:
+        return "refused-cpu", None
+    if proc.returncode != 0:
+        return "error", {"stderr": proc.stderr[-1500:]}
+    return "ok", None
+
+
+def _calibrated_tpu():
+    path = os.path.join(REPO, "tpu_olap", "planner",
+                        "cost_calibration.json")
+    try:
+        with open(path) as f:
+            return "tpu" in json.load(f)
+    except Exception:  # noqa: BLE001
+        return False
+
+
+# The window plan (VERDICT r3 task #1/#5/#6/#10), in priority order: the
+# Pallas A/B first (the banked auto run IS the Pallas leg on TPU), then
+# the per-query profile that explains the 69 ms floor and the 3x grouped
+# outliers, then the min/max+remap hardware validation, then the TPU cost
+# fit, and the SF10 scale proof last (slowest; dataset pre-generated under
+# .ssb_data so the window is spent ingesting + querying, not writing
+# parquet). Each leg is (event, done() predicate, run() thunk).
+def _bench_leg(fname, **kw):
+    def run():
+        s, rec = attempt_bench(**kw)
+        if s == "tpu":
+            with open(os.path.join(REPO, fname), "w") as f:
+                json.dump(rec, f, indent=1)
+            return "ok", {"value": rec.get("value")}
+        return ("refused-cpu" if s == "cpu" else s), rec
+    return run
+
+
+def _file_done(fname):
+    return lambda: os.path.exists(os.path.join(REPO, fname))
+
+
 EXTRA_LEGS = [
-    ("pallas-never bench", "BENCH_TPU_PALLAS_never.json",
-     dict(use_pallas="never")),
-    ("sf10 bench", "BENCH_TPU_SF10.json", dict(rows=60_000_000)),
+    ("pallas-never bench", _file_done("BENCH_TPU_PALLAS_never.json"),
+     _bench_leg("BENCH_TPU_PALLAS_never.json", use_pallas="never")),
+    ("per-query profile", _file_done("PROFILE_TPU.json"),
+     lambda: attempt_cmd(["tools/profile_tpu.py"])),
+    ("pallas hw validation", _file_done("PALLAS_TPU_VALIDATION.json"),
+     lambda: attempt_cmd(["tools/validate_pallas_tpu.py"])),
+    ("tpu cost calibration", _calibrated_tpu,
+     lambda: attempt_cmd(["tools/calibrate_cost.py"],
+                         {"CAL_REQUIRE_TPU": "1"})),
+    ("sf10 bench", _file_done("BENCH_TPU_SF10.json"),
+     _bench_leg("BENCH_TPU_SF10.json", rows=60_000_000)),
 ]
 MAX_LEG_FAILURES = 2  # deterministic failures must not eat the window
 
@@ -101,7 +159,7 @@ def main():
     start = time.time()
     n = 0
     banked = False
-    leg_failures = {fname: 0 for _, fname, _ in EXTRA_LEGS}
+    leg_failures = {event: 0 for event, _, _ in EXTRA_LEGS}
     if os.path.exists(BANK):
         with open(BANK) as f:
             banked = json.load(f).get("detail", {}).get("backend",
@@ -126,30 +184,26 @@ def main():
             log({"attempt": n, "status": "alive" if up else "down",
                  "elapsed_s": round(time.time() - t0, 1)})
         if up:
-            for event, fname, kw in EXTRA_LEGS:
-                path = os.path.join(REPO, fname)
-                if os.path.exists(path) or \
-                        leg_failures[fname] >= MAX_LEG_FAILURES:
+            for event, done, run in EXTRA_LEGS:
+                if done() or leg_failures[event] >= MAX_LEG_FAILURES:
                     continue
-                s2, r2 = attempt_bench(**kw)
+                s2, r2 = run()
                 log({"event": event, "status": s2,
-                     "value": (r2 or {}).get("value"),
+                     **({"value": r2.get("value")}
+                        if isinstance(r2, dict) and "value" in r2 else {}),
                      **({"error": r2} if s2 in ("error", "timeout")
                         and r2 else {})})
-                if s2 == "tpu":
-                    with open(path, "w") as f:
-                        json.dump(r2, f, indent=1)
-                elif s2 == "timeout" and not tunnel_alive():
+                if s2 == "ok":
+                    continue
+                if s2 in ("timeout", "refused-cpu") and not tunnel_alive():
                     break  # tunnel closed mid-run; retry next cycle
-                else:
-                    # deterministic error, or a leg too slow for the
-                    # attempt timeout while the tunnel is still up: cap
-                    # it so it cannot eat the whole window
-                    leg_failures[fname] += 1
+                # deterministic error, or a leg too slow for the attempt
+                # timeout while the tunnel is still up: cap it so it
+                # cannot eat the whole window
+                leg_failures[event] += 1
         legs_done = all(
-            os.path.exists(os.path.join(REPO, f))
-            or leg_failures[f] >= MAX_LEG_FAILURES
-            for _, f, _ in EXTRA_LEGS)
+            done() or leg_failures[event] >= MAX_LEG_FAILURES
+            for event, done, _ in EXTRA_LEGS)
         time.sleep(max(PERIOD, 3600) if banked and legs_done else PERIOD)
     log({"event": "probe loop done", "attempts": n, "banked": banked})
 
