@@ -164,6 +164,13 @@ def main():
     stored_mb = sum(c.nbytes for s in seg.segments
                     for c in s.columns.values()) // 2**20
 
+    # BENCH_RESULT_DIGEST=1 records a per-query sha256 over the rendered
+    # result frame — lets two runs of the same scale prove identical
+    # answers (e.g. an eviction-churn run vs the default-budget run)
+    # without shipping result rows in the artifact.
+    want_digest = env_flag("BENCH_RESULT_DIGEST")
+    digests = {}
+
     detail = {}
     for qname in sorted(QUERIES):
         sql = QUERIES[qname]
@@ -171,9 +178,13 @@ def main():
         # count, which re-sizes the packed result buffer; the second run
         # compiles the re-sized template so timed runs are all cache hits.
         eng.sql(sql)
-        eng.sql(sql)
+        res = eng.sql(sql)
         assert eng.last_plan.rewritten, (qname,
                                          eng.last_plan.fallback_reason)
+        if want_digest:
+            import hashlib
+            digests[qname] = hashlib.sha256(
+                res.to_csv(float_format="%.6g").encode()).hexdigest()[:16]
         times = []
         for _ in range(iters):
             t0 = time.perf_counter()
@@ -201,6 +212,7 @@ def main():
             "hbm": {"budget_bytes": hbm_budget,
                     "bytes_in_use": ledger.bytes_in_use,
                     "evictions": ledger.evictions},
+            **({"result_digests": digests} if want_digest else {}),
         },
     }))
 

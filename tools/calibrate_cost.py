@@ -131,8 +131,13 @@ def main():
         sys.exit(3)
     if backend == "cpu" and jax.device_count() < SHARDS:
         ensure_host_device_count(SHARDS)
-    SHARDS = min(SHARDS, jax.device_count(),
-                 int(os.environ.get("CAL_SHARDS", SHARDS)))
+    # clamp to the device count only on hardware (one tunnel chip => the
+    # single-device fit). On CPU the virtual 8-device mesh is the point:
+    # a clamp there would silently overwrite the banked 8-shard fit with
+    # a degraded single-device one when run without CAL_FORCE_CPU.
+    if backend != "cpu":
+        SHARDS = min(SHARDS, jax.device_count())
+    SHARDS = min(SHARDS, int(os.environ.get("CAL_SHARDS", SHARDS)))
     from tpu_olap.planner import cost as cost_mod
     if SHARDS < 2:
         return _calibrate_single_device(backend, cost_mod)

@@ -1,0 +1,130 @@
+"""Execute the DCN-shaped multi-host path with REAL multiple processes
+(VERDICT r3 missing #5): 2 x jax.distributed.initialize on the CPU
+platform, make_multihost_mesh over the global device set, shard_put of a
+segment-axis array from every host, and a shard_map psum + all_gather
+merge — the exact collective shapes the engine's sharded dispatch uses
+(executor/sharding.py). Writes MULTIHOST_2PROC.json.
+
+Until now make_multihost_mesh/shard_put were written multi-host-correct
+but had only ever executed single-process; this turns the dead path into
+a tested one. The production analog swaps the CPU platform + localhost
+coordinator for TPU pods — the jax API surface is identical
+(SURVEY.md §3.6: ICI within a slice, DCN across).
+
+Usage: python tools/multihost_check.py            # parent: spawns 2 workers
+       python tools/multihost_check.py <pid 0|1>  # worker mode
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+PORT = int(os.environ.get("MULTIHOST_PORT", 47311))
+NPROC = 2
+DEVS_PER_PROC = 4
+
+
+def worker(pid: int) -> None:
+    # env (XLA_FLAGS, JAX_PLATFORMS) is set by the parent before spawn;
+    # the platform config must still be applied before backend init
+    from tpu_olap.utils.platform import force_cpu_platform
+    force_cpu_platform()
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{PORT}",
+        num_processes=NPROC, process_id=pid)
+
+    import numpy as np
+    from tpu_olap.executor.sharding import (DATA_AXIS,
+                                            make_multihost_mesh,
+                                            shard_put)
+    from jax.sharding import PartitionSpec as P
+
+    n_dev = jax.device_count()
+    n_local = len(jax.local_devices())
+    assert n_dev == NPROC * DEVS_PER_PROC, (n_dev, jax.devices())
+    assert n_local == DEVS_PER_PROC, n_local
+
+    mesh = make_multihost_mesh(n_dev)
+
+    # segment-axis table: every process holds the full logical array and
+    # shard_put materializes only ITS addressable shards (the engine's
+    # DeviceDataset._put does the same)
+    segs, rows = n_dev * 3, 128
+    arr = np.arange(segs * rows, dtype=np.int64).reshape(segs, rows)
+    x = shard_put(arr, mesh)
+    assert len(x.addressable_shards) == DEVS_PER_PROC
+
+    # the engine's merge shape: per-chip partial reduce + psum merge
+    # (merge_collective's sum leg), plus an all_gather (its theta leg)
+    def local_reduce(a):
+        part = a.sum()
+        total = jax.lax.psum(part, DATA_AXIS)
+        parts = jax.lax.all_gather(part, DATA_AXIS)
+        return {"total": total, "parts": parts}
+
+    f = jax.jit(jax.shard_map(
+        local_reduce, mesh=mesh, in_specs=P(DATA_AXIS),
+        out_specs={"total": P(), "parts": P(DATA_AXIS)}))
+    out = f(x)
+    total = int(np.asarray(out["total"]).reshape(-1)[0])
+    expect = int(arr.sum())
+    assert total == expect, (total, expect)
+    # parts stays sharded across hosts (addressable shards only) — check
+    # this process's slice carries real per-chip partials
+    local_parts = [int(np.asarray(s.data).reshape(-1)[0])
+                   for s in out["parts"].addressable_shards]
+    assert len(local_parts) == DEVS_PER_PROC
+    print(json.dumps({"pid": pid, "devices": n_dev,
+                      "local_devices": n_local, "psum_total": total,
+                      "expect": expect, "ok": total == expect}))
+    jax.distributed.shutdown()
+
+
+def main() -> int:
+    if len(sys.argv) > 1:
+        worker(int(sys.argv[1]))
+        return 0
+
+    import re
+    env = dict(os.environ)
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (flags + f" --xla_force_host_platform_device_count="
+                        f"{DEVS_PER_PROC}").strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    t0 = time.time()
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), str(i)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, cwd=REPO) for i in range(NPROC)]
+    outs = []
+    ok = True
+    for i, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+            ok = False
+        line = out.strip().splitlines()[-1] if out.strip() else ""
+        rec = json.loads(line) if line.startswith("{") else \
+            {"pid": i, "ok": False, "stderr": err[-1500:]}
+        ok = ok and p.returncode == 0 and rec.get("ok", False)
+        outs.append(rec)
+    result = {"ok": ok, "processes": NPROC,
+              "devices_per_process": DEVS_PER_PROC,
+              "wall_s": round(time.time() - t0, 1), "workers": outs}
+    with open(os.path.join(REPO, "MULTIHOST_2PROC.json"), "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps({"ok": ok, "wall_s": result["wall_s"]}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

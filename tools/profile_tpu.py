@@ -23,8 +23,21 @@ import numpy as np  # noqa: E402
 
 
 def main():
+    force_cpu = bool(os.environ.get("PROFILE_FORCE_CPU"))
+    if force_cpu:
+        from tpu_olap.utils.platform import force_cpu_platform
+        force_cpu_platform()
     import jax
     import jax.numpy as jnp
+
+    if jax.default_backend() == "cpu" and not force_cpu:
+        # invoked expecting hardware (the probe's leg): a tunnel that
+        # closed between the liveness check and this process must not
+        # burn the window on a minutes-long CPU profile, and must not
+        # report success upstream (exit 3 = refused, probe retries)
+        print("backend resolved to cpu without PROFILE_FORCE_CPU; refusing",
+              file=sys.stderr)
+        sys.exit(3)
 
     backend = jax.default_backend()
     rows = int(os.environ.get("SSB_ROWS", 6_000_000))

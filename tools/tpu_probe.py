@@ -139,12 +139,25 @@ def _file_done(fname):
     return lambda: os.path.exists(os.path.join(REPO, fname))
 
 
+def _pallas_validation_done():
+    """Banked only when the suite ran CLEAN: a tunnel drop mid-suite
+    records transport errors as failures, and that artifact must not
+    mask a retry in the next window (a genuinely-failing suite stops
+    retrying via MAX_LEG_FAILURES and its last artifact stays banked)."""
+    path = os.path.join(REPO, "PALLAS_TPU_VALIDATION.json")
+    try:
+        with open(path) as f:
+            return json.load(f).get("failed") == 0
+    except Exception:  # noqa: BLE001
+        return False
+
+
 EXTRA_LEGS = [
     ("pallas-never bench", _file_done("BENCH_TPU_PALLAS_never.json"),
      _bench_leg("BENCH_TPU_PALLAS_never.json", use_pallas="never")),
     ("per-query profile", _file_done("PROFILE_TPU.json"),
      lambda: attempt_cmd(["tools/profile_tpu.py"])),
-    ("pallas hw validation", _file_done("PALLAS_TPU_VALIDATION.json"),
+    ("pallas hw validation", _pallas_validation_done,
      lambda: attempt_cmd(["tools/validate_pallas_tpu.py"])),
     ("tpu cost calibration", _calibrated_tpu,
      lambda: attempt_cmd(["tools/calibrate_cost.py"],
