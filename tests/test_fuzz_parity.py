@@ -68,6 +68,19 @@ _AGGS = [
     ("sum(qty) FILTER (WHERE region = 'west')", "sfw"),
     ("count(*) FILTER (WHERE price > 500.5)", "cfp"),
     ("avg(price) FILTER (WHERE small IN (1, 2))", "afs"),
+    # theta sketches + set ops (round 4; KMV is EXACT under capacity, and
+    # every column here has cardinality << the sketch k, so device
+    # estimates equal the fallback's exact distinct counts)
+    ("theta_sketch_estimate(theta_sketch(城市))", "tse"),
+    ("theta_sketch_estimate(theta_sketch_intersect("
+     "theta_sketch(城市) FILTER (WHERE region = 'west'), "
+     "theta_sketch(城市) FILTER (WHERE qty > 25)))", "tsi"),
+    ("theta_sketch_union("
+     "theta_sketch(cat) FILTER (WHERE small < 4), "
+     "theta_sketch(cat) FILTER (WHERE price > 300.25))", "tsu"),
+    ("theta_sketch_not("
+     "theta_sketch(城市) FILTER (WHERE small >= 2), "
+     "theta_sketch(城市) FILTER (WHERE small < 2))", "tsn"),
 ]
 _FILTERS = [
     "qty > 25", "qty BETWEEN -10 AND 80", "price < 500.5",
@@ -77,6 +90,13 @@ _FILTERS = [
     "substr(城市, 5, 1) = '3'",
     "(ts >= '2019-05-01' AND ts < '2019-08-01') "
     "OR (ts >= '2019-11-01' AND ts < '2020-01-15')",
+    # extraction filters (round 3 features, fuzz-weighted in round 4):
+    # case-fold selector/IN, substring IN, and extraction bound ranges
+    "upper(cat) = 'ALPHA'",
+    "upper(cat) IN ('ALPHA', 'BETA')",
+    "substr(城市, 5, 1) IN ('1', '3', '8')",
+    "substr(城市, 5, 1) >= '2' AND substr(城市, 5, 1) < '6'",
+    "lower(region) = 'west'",
 ]
 _TIME_EXPRS = [None, "year(ts)", "month(ts)", "quarter(ts)",
                "date_trunc('day', ts)"]

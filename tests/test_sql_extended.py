@@ -515,19 +515,37 @@ def test_exists_subquery():
     assert got["n"][0] == 0
 
 
-def test_correlated_subquery_rejected_clearly():
-    """A correlated reference must error legibly, never silently resolve
-    against the inner frame (qualifier stripping would otherwise turn
-    `b.x = a.x` into `b.x = b.x` = always true)."""
+def test_correlated_subquery_executes():
+    """Equality-correlated subqueries decorrelate and execute (round-4
+    margin close); they must NOT silently resolve the outer ref against
+    the inner frame (qualifier stripping would otherwise turn `b.x = a.x`
+    into `b.x = b.x` = always true)."""
+    eng, df = _engine()
+    eng.register_table("u", pd.DataFrame({"g": ["zz"], "v": [5]}),
+                       accelerate=False)
+    # no t.g value equals 'zz': EXISTS must be False for every row
+    got = eng.sql("SELECT count(*) AS n FROM t "
+                  "WHERE EXISTS (SELECT 1 FROM u WHERE u.g = t.g)")
+    assert got["n"][0] == 0
+    # scalar max over an empty correlated group is NULL: v > NULL is False
+    got = eng.sql("SELECT count(*) AS n FROM t "
+                  "WHERE v > (SELECT max(v) FROM u WHERE u.g = t.g)")
+    assert got["n"][0] == 0
+    # and a genuinely matching correlation agrees with pandas
+    got = eng.sql("SELECT count(*) AS n FROM t WHERE v > "
+                  "(SELECT avg(t2.v) FROM t t2 WHERE t2.g = t.g)")
+    avg = df.groupby("g")["v"].mean()
+    assert got["n"][0] == int((df["v"] > df["g"].map(avg)).sum())
+
+
+def test_correlated_subquery_unsupported_shape_rejected_clearly():
+    """Correlation shapes outside the equality class keep the legible
+    rejection (never a silent wrong answer)."""
     from tpu_olap.planner.fallback import FallbackError
     eng, _ = _engine()
-    eng.register_table("u", pd.DataFrame({"g": ["zz"]}), accelerate=False)
-    with pytest.raises(FallbackError, match="correlated subquery"):
+    with pytest.raises(FallbackError, match="correlated"):
         eng.sql("SELECT count(*) AS n FROM t "
-                "WHERE EXISTS (SELECT 1 FROM u WHERE u.g = t.g)")
-    with pytest.raises(FallbackError, match="correlated subquery"):
-        eng.sql("SELECT count(*) AS n FROM t "
-                "WHERE v > (SELECT max(v) FROM u WHERE u.g = t.g)")
+                "WHERE v > (SELECT avg(t2.v) FROM t t2 WHERE t2.v < t.v)")
 
 
 def test_case_folding_extraction_dims():

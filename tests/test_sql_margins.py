@@ -1,0 +1,182 @@
+"""SQL margins the reference served via full Spark SQL (SURVEY.md §3.1):
+RIGHT/FULL OUTER joins and equality-correlated subqueries (the TPC-H
+correlation class), both executing on the fallback path with pandas
+oracles."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpu_olap import Engine
+
+
+@pytest.fixture()
+def eng():
+    e = Engine()
+    rng = np.random.default_rng(17)
+    n = 500
+    fact = pd.DataFrame({
+        "ts": pd.to_datetime("2024-01-01")
+        + pd.to_timedelta(rng.integers(0, 86400 * 60, n), unit="s"),
+        "k": rng.integers(0, 12, n),
+        "grp": rng.choice(["a", "b", "c"], n),
+        "v": rng.integers(0, 100, n).astype(np.int64),
+    })
+    dim = pd.DataFrame({
+        # keys 8..15: overlaps fact on 8..11, 12..15 unmatched on the
+        # right; fact keys 0..7 unmatched on the left
+        "dk": np.arange(8, 16),
+        "dname": [f"d{i}" for i in range(8, 16)],
+    })
+    e.register_table("fact", fact, time_column="ts")
+    e.register_table("dim", dim)
+    return e, fact, dim
+
+
+def test_right_join(eng):
+    e, fact, dim = eng
+    got = e.sql("""SELECT dim.dname AS dname, count(fact.v) AS n
+                   FROM fact RIGHT JOIN dim ON fact.k = dim.dk
+                   GROUP BY dim.dname ORDER BY dname""")
+    m = fact.merge(dim, left_on="k", right_on="dk", how="right")
+    exp = m.groupby("dname", as_index=False).agg(n=("v", "count")) \
+        .sort_values("dname").reset_index(drop=True)
+    assert got["dname"].tolist() == exp["dname"].tolist()
+    assert got["n"].tolist() == exp["n"].tolist()
+    # unmatched dim rows (dk 12..15) must be present with count 0
+    assert {"d12", "d13", "d14", "d15"} <= set(got["dname"])
+
+
+def test_full_outer_join(eng):
+    e, fact, dim = eng
+    got = e.sql("""SELECT k, dname FROM fact FULL OUTER JOIN dim
+                   ON fact.k = dim.dk WHERE v > 1000 OR v IS NULL
+                   ORDER BY dname""")
+    # v > 1000 never true: only unmatched right rows (v NULL) survive
+    assert got["dname"].tolist() == ["d12", "d13", "d14", "d15"]
+    assert got["k"].isna().all()
+
+
+def test_full_outer_counts(eng):
+    e, fact, dim = eng
+    got = e.sql("""SELECT count(*) AS total FROM fact
+                   FULL JOIN dim ON fact.k = dim.dk""")
+    m = fact.merge(dim, left_on="k", right_on="dk", how="outer")
+    assert int(got["total"].iloc[0]) == len(m)
+
+
+def test_left_join_extra_on_conjunct_preserves_unmatched(eng):
+    """ON a=b AND extra must not re-filter unmatched left rows (the SQL
+    outer-join contract; a naive post-merge filter drops them)."""
+    e, fact, dim = eng
+    got = e.sql("""SELECT count(*) AS n,
+                          count(dim.dname) AS matched
+                   FROM fact LEFT JOIN dim
+                   ON fact.k = dim.dk AND dim.dk > 9""")
+    m = fact.merge(dim, left_on="k", right_on="dk", how="inner")
+    m = m[m["dk"] > 9]
+    assert int(got["n"].iloc[0]) == len(fact) - fact["k"].isin(
+        m["dk"].unique()).sum() + len(m)
+    assert int(got["matched"].iloc[0]) == len(m)
+
+
+def test_correlated_scalar_avg(eng):
+    """TPC-H Q17 shape: compare each row against its group's average."""
+    e, fact, _ = eng
+    got = e.sql("""SELECT count(*) AS n FROM fact
+                   WHERE v > (SELECT avg(f2.v) FROM fact f2
+                              WHERE f2.k = fact.k)""")
+    avg = fact.groupby("k")["v"].mean()
+    exp = int((fact["v"] > fact["k"].map(avg)).sum())
+    assert int(got["n"].iloc[0]) == exp
+
+
+def test_correlated_scalar_in_projection(eng):
+    e, fact, dim = eng
+    got = e.sql("""SELECT dk, (SELECT max(fact.v) FROM fact
+                               WHERE fact.k = dim.dk) AS mx
+                   FROM dim ORDER BY dk""")
+    mx = fact.groupby("k")["v"].max()
+    exp = [mx.get(k) for k in sorted(dim["dk"])]
+    for g, x in zip(got["mx"], exp):
+        if x is None or (isinstance(x, float) and np.isnan(x)):
+            assert pd.isna(g)
+        else:
+            assert g == x
+
+
+def test_correlated_scalar_empty_group_null_and_count_zero(eng):
+    e, fact, dim = eng
+    # dk 12..15 match no fact rows: max -> NULL, count -> 0
+    got = e.sql("""SELECT dk,
+                     (SELECT max(v) FROM fact WHERE fact.k = dim.dk) AS mx,
+                     (SELECT count(*) FROM fact WHERE fact.k = dim.dk) AS c
+                   FROM dim WHERE dk >= 12 ORDER BY dk""")
+    assert got["mx"].isna().all()
+    assert got["c"].tolist() == [0, 0, 0, 0]
+
+
+def test_correlated_exists_and_not_exists(eng):
+    e, fact, dim = eng
+    got = e.sql("""SELECT count(*) AS n FROM dim
+                   WHERE EXISTS (SELECT 1 FROM fact
+                                 WHERE fact.k = dim.dk AND fact.v > 50)""")
+    keys = set(fact.loc[fact["v"] > 50, "k"])
+    exp = int(dim["dk"].isin(keys).sum())
+    assert int(got["n"].iloc[0]) == exp
+
+    got2 = e.sql("""SELECT count(*) AS n FROM dim
+                    WHERE NOT EXISTS (SELECT 1 FROM fact
+                                      WHERE fact.k = dim.dk)""")
+    exp2 = int((~dim["dk"].isin(set(fact["k"]))).sum())
+    assert int(got2["n"].iloc[0]) == exp2
+
+
+def test_correlated_in(eng):
+    e, fact, dim = eng
+    got = e.sql("""SELECT count(*) AS n FROM fact
+                   WHERE grp IN (SELECT f2.grp FROM fact f2
+                                 WHERE f2.k = fact.k AND f2.v >= 90)""")
+    hi = fact[fact["v"] >= 90]
+    pairs = set(zip(hi["k"], hi["grp"]))
+    exp = int(sum((k, g) in pairs
+                  for k, g in zip(fact["k"], fact["grp"])))
+    assert int(got["n"].iloc[0]) == exp
+
+
+def test_correlated_multi_key(eng):
+    e, fact, _ = eng
+    got = e.sql("""SELECT count(*) AS n FROM fact
+                   WHERE v >= (SELECT max(f2.v) FROM fact f2
+                               WHERE f2.k = fact.k AND f2.grp = fact.grp)""")
+    mx = fact.groupby(["k", "grp"])["v"].transform("max")
+    exp = int((fact["v"] >= mx).sum())
+    assert int(got["n"].iloc[0]) == exp
+
+
+def test_exists_over_ungrouped_aggregate_is_always_true(eng):
+    """SQL: an ungrouped aggregate subquery yields one row even over
+    zero input rows, so EXISTS over it is true for every outer row."""
+    e, _, dim = eng
+    got = e.sql("""SELECT count(*) AS n FROM dim
+                   WHERE EXISTS (SELECT max(v) FROM fact
+                                 WHERE fact.k = dim.dk)""")
+    assert int(got["n"].iloc[0]) == len(dim)
+
+
+def test_aliased_self_join_rejected_not_wrong(eng):
+    """Qualifier-stripping cannot disambiguate an aliased multi-table
+    scope (a.v vs b.v over the same table) — it must reject, never
+    silently read the wrong frame."""
+    e, _, _ = eng
+    with pytest.raises(Exception, match="alias"):
+        e.sql("""SELECT a.v AS av, b.v AS bv FROM fact a
+                 JOIN fact b ON a.k = b.k LIMIT 5""")
+
+
+def test_non_equality_correlation_still_legible(eng):
+    e, _, _ = eng
+    with pytest.raises(Exception, match="correlat|not supported"):
+        e.sql("""SELECT count(*) AS n FROM fact
+                 WHERE v > (SELECT avg(f2.v) FROM fact f2
+                            WHERE f2.k > fact.k)""")

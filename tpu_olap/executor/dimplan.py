@@ -36,8 +36,23 @@ class DimPlan:
     remap_name: str | None = None   # ConstPool name for remap/offset consts
     offset_name: str | None = None
     time_plan: object = None        # BucketPlan for timeformat dims
+    # content hash for gather-needing kinds (remap/timeformat): the
+    # runner precomputes these id streams ONCE per table as
+    # device-resident derived columns (a per-dispatch 1-D gather over
+    # every row costs ~60 ms on a v5e through XLA; resident ids cost
+    # one HBM read like any column). ids() consumes the cached stream
+    # when the env carries it under "\0d:<token>".
+    cache_token: str | None = None
+
+    @property
+    def derived_name(self) -> str | None:
+        return None if self.cache_token is None else "\0d:" + self.cache_token
 
     def ids(self, env, consts, xp):
+        if self.cache_token is not None:
+            hit = env["cols"].get("\0d:" + self.cache_token)
+            if hit is not None:
+                return hit
         if self.kind == "codes":
             return env["cols"][self.source_col]
         if self.kind == "numeric":
@@ -104,7 +119,10 @@ def compile_dimension(spec, table, pool, t_min, t_max,
             labels = np.array(values, object)
             return DimPlan(spec.name, len(values), labels, None,
                            "timeformat", remap_name=remap_name,
-                           time_plan=plan)
+                           time_plan=plan,
+                           cache_token=_dim_token(
+                               "tf", ex.format, ex.time_zone, t_min, t_max,
+                               pool.consts[remap_name]))
         if col not in table.schema or table.schema[col] is not ColumnType.STRING:
             raise UnsupportedDimension(
                 f"extraction dimension over non-string column {col!r}")
@@ -119,8 +137,25 @@ def compile_dimension(spec, table, pool, t_min, t_max,
         labels[0] = None
         labels[1:] = values
         return DimPlan(spec.name, len(values) + 1, labels, col, "remap",
-                       remap_name=pool.add(remap))
+                       remap_name=pool.add(remap),
+                       cache_token=_dim_token("rm", col, remap))
     raise UnsupportedDimension(f"unknown dimension spec {type(spec).__name__}")
+
+
+def _dim_token(*parts) -> str:
+    """Content hash over everything the derived id stream depends on:
+    the remap table bytes + the source identity (+ time params for
+    timeformat). Two queries with the same restriction share one cached
+    stream; different restrictions cache separately."""
+    import hashlib
+    h = hashlib.sha1()
+    for p in parts:
+        if isinstance(p, np.ndarray):
+            h.update(p.tobytes())
+        else:
+            h.update(repr(p).encode())
+        h.update(b"\x1f")
+    return h.hexdigest()[:16]
 
 
 def _dense_numeric_plan(name, source_col, lo, hi, pool,

@@ -256,9 +256,12 @@ def _restrict_dims(dim_plans, filter_spec, table, pool):
         for i, c in enumerate(codes):
             remap[c] = i + 1
             labels[i + 1] = d.values[c - 1]
+        from tpu_olap.executor.dimplan import _dim_token
         out.append(DimPlan(dp.name, len(codes) + 1, labels,
                            dp.source_col, "remap",
-                           remap_name=pool.add(remap)))
+                           remap_name=pool.add(remap),
+                           cache_token=_dim_token("rs", dp.source_col,
+                                                  remap)))
     return out
 
 

@@ -343,6 +343,21 @@ class QueryRunner:
         table = plan.table
         ds = self._dataset(table)
         env = ds.env(plan.columns, plan.null_cols)
+        tokens = [dp.cache_token for dp in plan.dim_plans
+                  if dp.cache_token is not None]
+        if tokens:
+            # pin this query's whole working set (columns + every derived
+            # stream it needs) so one derived add cannot evict another
+            pinned = frozenset(
+                [(table.name, "col", c) for c in plan.columns]
+                + [(table.name, "null", c) for c in plan.null_cols]
+                + [(table.name, "derived", t) for t in tokens])
+            for dp in plan.dim_plans:
+                if dp.cache_token is not None:
+                    env["cols"][dp.derived_name] = ds.derived(
+                        dp.cache_token,
+                        lambda dp=dp: self._build_derived(ds, plan, dp),
+                        pinned)
         valid = ds.valid()
         seg_mask = ds.segment_mask(plan.pruned_ids if not plan.empty else [])
         metrics["segments_total"] = len(table.segments)
@@ -354,6 +369,32 @@ class QueryRunner:
             metrics["hbm_bytes"] = self._hbm_ledger.bytes_in_use
             metrics["hbm_evictions"] = self._hbm_ledger.evictions
         return env, valid, seg_mask
+
+    def _build_derived(self, ds, plan: PhysicalPlan, dp):
+        """Materialize one precomputed dim id stream [S, R] int32 on the
+        dataset's platform from its resident source column (dictionary
+        codes for remap, __time for timeformat)."""
+        src = dp.source_col if dp.source_col is not None else TIME_COLUMN
+        col = ds.col(src)
+        consts = plan.pool.consts
+        if self.config.platform == "cpu":
+            shape = np.asarray(col).shape
+            flat = {"cols": {src: np.asarray(col).reshape(-1)},
+                    "nulls": {}}
+            return np.asarray(dp.ids(flat, consts, np),
+                              np.int32).reshape(shape)
+        import jax
+        import jax.numpy as jnp
+
+        def f(c):
+            # no reshape: ids() is elementwise/shape-polymorphic, and
+            # keeping [S, R] lets the output inherit the input's segment
+            # sharding under a mesh without a gather
+            env2 = {"cols": {src: c}, "nulls": {}}
+            cdev = {k: jnp.asarray(v) for k, v in consts.items()}
+            return dp.ids(env2, cdev, jnp).astype(jnp.int32)
+
+        return jax.jit(f)(col)
 
     def _run_partials(self, plan: PhysicalPlan, metrics: dict) -> dict:
         env, valid, seg_mask = self._prepare(plan, metrics)

@@ -47,7 +47,13 @@ def execute_fallback(stmt, catalog, config) -> pd.DataFrame:
             # SF-scale parquet table: stream row-group chunks instead of
             # materializing one frame (SURVEY.md §2 property 2 at scale)
             return _execute_chunked(stmt, entry, catalog, config)
-        df = entry.frame.copy()
+        df = entry.frame
+        if any(isinstance(c, Lit) and c.value is False
+               for c in _split_and(stmt.where)):
+            # a statically-false WHERE conjunct (e.g. the decorrelator's
+            # empty-input default probe): skip the full copy + time sort
+            df = df.iloc[0:0]
+        df = df.copy()
         time_col = entry.time_column
         if time_col is not None and time_col in df.columns:
             # match the accelerated path's deterministic time-sorted row
@@ -173,9 +179,7 @@ def _check_uncorrelated(stmt):
             for p in s.parts:
                 out |= scope_tables(p)
             return out
-        tables = {s.table}
-        tables |= {j.table for j in s.joins}
-        return tables
+        return _scope_names(s)
 
     def walk_expr(e, tables):
         if e is None or isinstance(e, Lit):
@@ -193,7 +197,14 @@ def _check_uncorrelated(stmt):
         if isinstance(e, BinOp):
             walk_expr(e.left, tables)
             walk_expr(e.right, tables)
-        elif isinstance(e, (FuncCall, WindowCall)):
+        elif isinstance(e, WindowCall):
+            for a in e.args:
+                walk_expr(a, tables)
+            for p in e.partition_by:
+                walk_expr(p, tables)
+            for oe, _ in e.order_by:
+                walk_expr(oe, tables)
+        elif isinstance(e, FuncCall):
             for a in e.args:
                 walk_expr(a, tables)
 
@@ -232,37 +243,65 @@ def _scalar_from(sub_df: pd.DataFrame):
     return v.item() if hasattr(v, "item") else v
 
 
+def _scope_names(s) -> set:
+    """Qualifier names resolvable in s's own FROM/JOIN scope. An alias
+    HIDES the base table name (standard SQL): `FROM fact f2` makes
+    `fact.x` an OUTER reference inside that scope."""
+    names = {s.table_alias or s.table}
+    names |= {j.alias or j.table for j in s.joins}
+    return names
+
+
+def _uncorrelated(stmt) -> bool:
+    try:
+        _check_uncorrelated(stmt)
+        return True
+    except FallbackError:
+        return False
+
+
 def _resolve_subqueries(stmt: SelectStmt, catalog, config) -> SelectStmt:
     """Replace Subquery nodes (scalar) and in_subquery calls (IN lists)
     with literals by executing the nested statements, and LOOKUP(col,
     'name') references with their registered map inlined (the evaluator
-    has no catalog access). Non-correlated only; the planner already
-    routed any statement containing one here."""
+    has no catalog access). Equality-correlated subqueries (the TPC-H
+    class: scalar aggregates, EXISTS, IN) decorrelate into precomputed
+    key->value maps evaluated per outer row; any other correlation shape
+    keeps the legible rejection."""
     hit = False
+    outer_tables = _scope_names(stmt) if isinstance(stmt, SelectStmt) \
+        else set()
 
     def walk(e):
         nonlocal hit
         if e is None or isinstance(e, (Lit, Col)):
             return e
         if isinstance(e, FuncCall) and e.name == "exists":
-            # EXISTS (SELECT ...): true iff the (non-correlated)
-            # subquery returns any row — one row is enough, so cap it
+            # EXISTS (SELECT ...): true iff the subquery returns any row
+            # — one row is enough, so cap it
             hit = True
             import dataclasses as _dc
-            inner = _check_uncorrelated(e.args[0].stmt)
-            inner = _dc.replace(inner, limit=1, order_by=[])
+            s = e.args[0].stmt
+            if not _uncorrelated(s):
+                return _decorrelate_exists(s, outer_tables, catalog,
+                                           config)
+            inner = _dc.replace(s, limit=1, order_by=[])
             sub = execute_fallback(inner, catalog, config)
             return Lit(len(sub) > 0)
         if isinstance(e, Subquery):
             hit = True
+            if not _uncorrelated(e.stmt):
+                return _decorrelate_scalar(e.stmt, outer_tables, catalog,
+                                           config)
             return Lit(_scalar_from(
-                execute_fallback(_check_uncorrelated(e.stmt), catalog,
-                                 config)))
+                execute_fallback(e.stmt, catalog, config)))
         if isinstance(e, FuncCall) and e.name == "in_subquery":
             hit = True
             lhs = walk(e.args[0])
-            sub = execute_fallback(_check_uncorrelated(e.args[1].stmt),
-                                   catalog, config)
+            if not _uncorrelated(e.args[1].stmt):
+                return _decorrelate_in(lhs, e.args[1].stmt, outer_tables,
+                                       catalog, config)
+            sub = execute_fallback(e.args[1].stmt, catalog, config)
             if sub.shape[1] != 1:
                 raise FallbackError(
                     f"IN subquery returned {sub.shape[1]} columns")
@@ -300,13 +339,281 @@ def _resolve_subqueries(stmt: SelectStmt, catalog, config) -> SelectStmt:
     return out if hit else stmt
 
 
+# ---------------------------------------------------------------------------
+# Decorrelation (SURVEY.md §3.1 margin the reference served via Spark SQL):
+# an equality-correlated subquery  (... WHERE inner_expr = outer.col ...)
+# becomes a pre-aggregated key->value map over the inner table, evaluated
+# per outer row by corr_*_map — the classic magic-set rewrite of the
+# TPC-H correlation class (Q2/Q4/Q17/Q21/Q22 shapes), without needing
+# derived-frame join plumbing.
+
+
+def _plain(v):
+    """Frame cell -> hashable python scalar (None for SQL null)."""
+    if v is None or (not isinstance(v, (str, bytes, tuple)) and pd.isna(v)):
+        return None
+    return v.item() if hasattr(v, "item") else v
+
+
+def _key_rows(kser):
+    """Row-major normalized key tuples from key Series — one .tolist()
+    per column (C-level scalar conversion) instead of per-cell .iloc,
+    since these maps evaluate on frames up to fallback_scan_row_cap."""
+    cols = [[_plain(x) for x in s.tolist()] for s in kser]
+    return zip(*cols)
+
+
+def _and_all(conjs):
+    out = None
+    for c in conjs:
+        out = c if out is None else BinOp("&&", out, c)
+    return out
+
+
+def _corr_split(s, outer_tables):
+    """Split the subquery WHERE into correlation keys and residual:
+    keys = [(inner_expr, outer Col)] from equality conjuncts referencing
+    the outer scope; residual = pure-inner conjuncts. Raises legibly for
+    any other correlation shape (non-equality, outer refs outside WHERE,
+    refs to a scope that is neither inner nor the immediate outer)."""
+    if isinstance(s, UnionStmt):
+        raise FallbackError("correlated UNION subquery is not supported")
+    inner_tables = _scope_names(s)
+
+    def outer_col(x):
+        return (isinstance(x, Col) and "." in x.name
+                and x.name.rsplit(".", 1)[0] not in inner_tables)
+
+    def refs_outer(x):
+        if x is None or isinstance(x, (Lit, Subquery)):
+            return False
+        if isinstance(x, Col):
+            return outer_col(x)
+        if isinstance(x, BinOp):
+            return refs_outer(x.left) or refs_outer(x.right)
+        if isinstance(x, WindowCall):
+            return (any(refs_outer(a) for a in x.args)
+                    or any(refs_outer(p) for p in x.partition_by)
+                    or any(refs_outer(oe) for oe, _ in x.order_by))
+        if isinstance(x, FuncCall):
+            return any(refs_outer(a) for a in x.args)
+        return False
+
+    keys, residual = [], []
+    for c in _split_and(s.where):
+        if not refs_outer(c):
+            residual.append(c)
+            continue
+        ok = False
+        if isinstance(c, BinOp) and c.op == "==":
+            for ie, oe in ((c.right, c.left), (c.left, c.right)):
+                if outer_col(oe) and not refs_outer(ie):
+                    qual = oe.name.rsplit(".", 1)[0]
+                    if qual not in outer_tables:
+                        raise FallbackError(
+                            f"subquery reference {oe.name!r} names a "
+                            "table in neither the subquery nor the "
+                            "immediately enclosing query")
+                    keys.append((ie, oe))
+                    ok = True
+                    break
+        if not ok:
+            raise FallbackError(
+                "correlated subquery: only equality correlation to an "
+                f"outer column is decorrelated (got {_auto_name(c)!r})")
+    if not keys:
+        raise FallbackError(
+            "correlated subquery reference outside WHERE is not "
+            "supported (rewrite as a join)")
+    for e, _ in s.projections:
+        if refs_outer(e):
+            raise FallbackError(
+                "correlated subquery: outer references are only "
+                "decorrelated inside WHERE equality conjuncts")
+    for j in s.joins:
+        if refs_outer(j.on):
+            raise FallbackError(
+                "correlated subquery: outer reference in a JOIN "
+                "condition is not supported")
+    for coll in (s.group_by, [i.expr for i in s.order_by]):
+        for e in coll:
+            if refs_outer(e):
+                raise FallbackError(
+                    "correlated subquery: outer references are only "
+                    "decorrelated inside WHERE equality conjuncts")
+    if s.having is not None and refs_outer(s.having):
+        raise FallbackError(
+            "correlated subquery: outer reference in HAVING is not "
+            "supported")
+    return keys, residual
+
+
+def _corr_shape_guard(s, what):
+    if isinstance(s, UnionStmt):
+        raise FallbackError(f"correlated {what}: UNION is not supported")
+    if s.group_by or s.having is not None or s.derived is not None \
+            or s.limit is not None or s.offset:
+        raise FallbackError(
+            f"correlated {what}: only a plain FROM/WHERE subquery is "
+            "decorrelated (rewrite as a join)")
+
+
+def _decorrelate_scalar(s, outer_tables, catalog, config):
+    """(SELECT agg(...) FROM u WHERE u.k = t.k AND residual) -> a
+    key->scalar map; outer rows with no matching key take the aggregate's
+    empty-input value (NULL, or 0 for COUNT) computed by actually running
+    the aggregate over zero rows."""
+    import dataclasses as _dc
+    _corr_shape_guard(s, "scalar subquery")
+    if len(s.projections) != 1 or not _contains_agg(s.projections[0][0]):
+        raise FallbackError(
+            "correlated scalar subquery must project exactly one "
+            "aggregate expression")
+    keys, residual = _corr_split(s, outer_tables)
+    proj = s.projections[0][0]
+    gproj = [(ie, f"__ck{i}") for i, (ie, _) in enumerate(keys)]
+    inner = _dc.replace(
+        s, projections=gproj + [(proj, "__sc")], distinct=False,
+        group_by=[ie for ie, _ in keys], where=_and_all(residual),
+        order_by=[], limit=None, offset=0)
+    try:
+        sub = execute_fallback(inner, catalog, config)
+        # empty-input probe: keep the pure-inner residual (comma joins
+        # need their conditions) and conjoin a statically-false leaf
+        empty = _dc.replace(s, where=_and_all(residual + [Lit(False)]),
+                            order_by=[], limit=None, offset=0)
+        default = _scalar_from(execute_fallback(empty, catalog, config))
+    except FallbackError as err:
+        # e.g. an UNQUALIFIED outer reference in the SELECT list resolves
+        # as an unknown inner column — surface it as the correlation
+        # limit it is, not a phantom missing column
+        raise FallbackError(
+            f"correlated scalar subquery did not decorrelate: {err}")
+    items = []
+    kcols = [sub[f"__ck{j}"] for j in range(len(keys))]
+    vals = [_plain(v) for v in sub["__sc"].tolist()]
+    for kt, v in zip(_key_rows(kcols), vals):
+        if any(k is None for k in kt):
+            continue  # a NULL key never equals anything
+        items.append((kt, v))
+    return FuncCall("corr_scalar_map",
+                    (Lit(tuple(items)), Lit(default))
+                    + tuple(oe for _, oe in keys))
+
+
+def _decorrelate_exists(s, outer_tables, catalog, config):
+    """EXISTS (SELECT ... FROM u WHERE u.k = t.k AND residual) -> a
+    membership set over the correlation keys (semi-join)."""
+    import dataclasses as _dc
+    _corr_shape_guard(s, "EXISTS")
+    if any(_contains_agg(e) for e, _ in s.projections):
+        # an ungrouped aggregate subquery yields exactly one row even
+        # over zero input rows, so EXISTS is true for EVERY outer row
+        # (group_by shapes never reach here: _corr_shape_guard rejects)
+        return Lit(True)
+    keys, residual = _corr_split(s, outer_tables)
+    inner = _dc.replace(
+        s, projections=[(ie, f"__ck{i}") for i, (ie, _) in enumerate(keys)],
+        distinct=True, group_by=[], where=_and_all(residual),
+        order_by=[], limit=None, offset=0)
+    sub = execute_fallback(inner, catalog, config)
+    kcols = [sub[f"__ck{j}"] for j in range(len(keys))]
+    keyset = {kt for kt in _key_rows(kcols)
+              if not any(k is None for k in kt)}
+    return FuncCall("corr_exists_map",
+                    (Lit(tuple(keyset)),) + tuple(oe for _, oe in keys))
+
+
+def _decorrelate_in(lhs, s, outer_tables, catalog, config):
+    """x IN (SELECT y FROM u WHERE u.k = t.k AND residual) -> membership
+    over (key..., y) tuples; NULL x or NULL y never match (the engine's
+    comparisons-with-NULL-are-False rule)."""
+    import dataclasses as _dc
+    _corr_shape_guard(s, "IN subquery")
+    if len(s.projections) != 1:
+        raise FallbackError("IN subquery must project exactly one column")
+    keys, residual = _corr_split(s, outer_tables)
+    ve = s.projections[0][0]
+    inner = _dc.replace(
+        s, projections=[(ie, f"__ck{i}")
+                        for i, (ie, _) in enumerate(keys)] + [(ve, "__v")],
+        distinct=True, group_by=[], where=_and_all(residual),
+        order_by=[], limit=None, offset=0)
+    sub = execute_fallback(inner, catalog, config)
+    if len(sub) > config.fallback_scan_row_cap:
+        raise FallbackError(
+            "IN subquery result exceeds fallback_scan_row_cap")
+    kcols = [sub[f"__ck{j}"] for j in range(len(keys))] + [sub["__v"]]
+    pairs = {kt for kt in _key_rows(kcols)
+             if not any(k is None for k in kt)}
+    return FuncCall("corr_in_map",
+                    (Lit(tuple(pairs)), lhs) + tuple(oe for _, oe in keys))
+
+
+_JOIN_HOW = {"inner": "inner", "left": "left", "right": "right",
+             "full": "outer"}
+
+
+def _merge_one(df, other, j, lcol, rcol, extras, time_col):
+    """One join step. Extra ON conjuncts participate in the MATCH for
+    outer kinds (SQL: an unmatched preserved row keeps NULLs — it is not
+    re-filtered by the ON condition), so those kinds take an inner match
+    + add-back-unmatched construction; a plain post-merge filter would
+    silently turn LEFT JOIN ... ON a=b AND extra into an inner join."""
+    sfx = ("", f"__{j.table}")
+    if j.kind == "inner" or not extras:
+        out = df.merge(other, left_on=lcol, right_on=rcol,
+                       how=_JOIN_HOW[j.kind], suffixes=sfx)
+        for c in extras:  # inner only: filtering == matching
+            out = out[_eval_bool(c, out, time_col)]
+        return out
+    ldf = df.reset_index(drop=True).copy()
+    ldf["__lid"] = np.arange(len(ldf))
+    rdf = other.reset_index(drop=True).copy()
+    rdf["__rid"] = np.arange(len(rdf))
+    m = ldf.merge(rdf, left_on=lcol, right_on=rcol, how="inner",
+                  suffixes=sfx)
+    for c in extras:
+        m = m[_eval_bool(c, m, time_col)]
+    parts = [m]
+    if j.kind in ("left", "full"):
+        parts.append(ldf[~ldf["__lid"].isin(m["__lid"])])
+    if j.kind in ("right", "full"):
+        un = rdf[~rdf["__rid"].isin(m["__rid"])]
+        collide = [c for c in un.columns if c in ldf.columns]
+        # same-named join keys coalesce into ONE output column in the
+        # merged frame; keep the unmatched right rows' key under that
+        # coalesced name instead of suffixing it away (else every
+        # preserved-but-unmatched row reads NULL for its own key)
+        ren = {c: c + sfx[1] for c in collide
+               if not (c == rcol and rcol == lcol)}
+        parts.append(un.rename(columns=ren))
+    out = pd.concat(parts, ignore_index=True)
+    return out.drop(columns=[c for c in ("__lid", "__rid")
+                             if c in out.columns])
+
+
 def _join_and_filter(stmt, df, catalog, time_col):
-    """Apply the statement's joins (inner equi-joins; conditions from ON
-    or WHERE) and residual WHERE conjuncts to one frame. Fixed point over
+    """Apply the statement's joins (equi-joins; conditions from ON or
+    WHERE) and residual WHERE conjuncts to one frame. Fixed point over
     the join list: a snowflake chain's parent may be listed after its
-    child, and the link column only appears once the parent merges."""
+    child, and the link column only appears once the parent merges.
+    RIGHT/FULL OUTER joins are order-sensitive, so their presence pins
+    strict listed-order processing (no deferral)."""
+    if stmt.joins and (stmt.table_alias is not None
+                       or any(j.alias is not None for j in stmt.joins)):
+        # the evaluator resolves qualified refs by STRIPPING the
+        # qualifier, which is only sound when every qualifier maps to a
+        # distinct table frame — an aliased multi-table scope (e.g. a
+        # self-join `t a JOIN t b`) would silently read the wrong frame;
+        # reject instead (single-table aliases, incl. inside correlated
+        # subqueries, are fine and used by decorrelation)
+        raise FallbackError(
+            "table aliases in a multi-table FROM are not supported "
+            "(qualified refs would not disambiguate same-named columns)")
     where_conjs = _split_and(stmt.where)
     pending = list(stmt.joins)
+    strict = any(j.kind in ("right", "full") for j in pending)
     while pending:
         still = []
         for j in pending:
@@ -319,17 +626,18 @@ def _join_and_filter(stmt, df, catalog, time_col):
                     pair = (c, p)
                     break
             if pair is None:
+                if strict:
+                    raise FallbackError(
+                        f"no join condition for {j.table!r} at its "
+                        "position (RIGHT/FULL joins run in listed order)")
                 still.append(j)
                 continue
             cond, (lcol, rcol) = pair
             if j.on is None:
                 where_conjs.remove(cond)
-            how = "left" if j.kind == "left" else "inner"
-            df = df.merge(other, left_on=lcol, right_on=rcol, how=how,
-                          suffixes=("", f"__{j.table}"))
-            if j.on is not None:
-                for extra in [c for c in _split_and(j.on) if c is not cond]:
-                    df = df[_eval_bool(extra, df, time_col)]
+            extras = [c for c in _split_and(j.on) if c is not cond] \
+                if j.on is not None else []
+            df = _merge_one(df, other, j, lcol, rcol, extras, time_col)
         if len(still) == len(pending):
             raise FallbackError(
                 f"no join condition for {still[0].table!r}")
@@ -534,6 +842,15 @@ def _execute_chunked(stmt: SelectStmt, entry, catalog, config):
     non-aggregate result larger than fallback_scan_row_cap refuses with a
     clear error instead of exhausting host RAM."""
     time_col = entry.time_column
+    if any(j.kind in ("right", "full") for j in stmt.joins):
+        # per-chunk outer joins would re-emit every unmatched right row
+        # once per chunk; correct chunked outer joins need global match
+        # tracking, which the whole-frame path provides below the
+        # chunking threshold
+        raise FallbackError(
+            "RIGHT/FULL OUTER join over a chunked-scale table is not "
+            "supported; reduce the table or flip the join around the "
+            "smaller side")
     batch = config.fallback_chunk_batch_rows
     chunks = entry.iter_chunks(batch)
 
@@ -1254,6 +1571,31 @@ def _eval(e, df, time_col):
             end = None if ln is None else start + ln
             return v.map(lambda x: None if pd.isna(x)
                          else str(x)[start:end])
+        if fn == "corr_scalar_map":
+            items = dict(e.args[0].value)
+            default = e.args[1].value
+            kser = [_eval(a, df, time_col) for a in e.args[2:]]
+            if not len(df):
+                return pd.Series([], dtype=object)
+            vals = [items.get(kt, default) for kt in _key_rows(kser)]
+            return pd.Series([np.nan if v is None else v for v in vals],
+                             index=df.index)
+        if fn == "corr_exists_map":
+            keyset = set(e.args[0].value)
+            kser = [_eval(a, df, time_col) for a in e.args[1:]]
+            if not len(df):
+                return pd.Series([], dtype=bool)
+            return pd.Series([kt in keyset for kt in _key_rows(kser)],
+                             index=df.index)
+        if fn == "corr_in_map":
+            pairs = set(e.args[0].value)
+            lhs = _eval(e.args[1], df, time_col)
+            kser = [_eval(a, df, time_col) for a in e.args[2:]]
+            if not len(df):
+                return pd.Series([], dtype=bool)
+            return pd.Series([kt in pairs
+                              for kt in _key_rows(kser + [lhs])],
+                             index=df.index)
         if fn == "lookup_map":
             v = _eval(e.args[0], df, time_col)
             m = dict(e.args[1].value)

@@ -39,7 +39,8 @@ _TOKEN = re.compile(r"""
 _KEYWORDS = {
     "select", "from", "where", "group", "by", "having", "order", "limit",
     "offset", "as", "and", "or", "not", "between", "in", "like", "is",
-    "null", "asc", "desc", "join", "inner", "left", "on", "distinct",
+    "null", "asc", "desc", "join", "inner", "left", "right", "full",
+    "outer", "on", "distinct",
     "case", "when", "then", "else", "end", "cast", "union", "all", "with",
     "intersect", "except", "exists",
 }
@@ -93,6 +94,9 @@ class JoinClause:
     table: str
     on: Expr | None  # None for comma joins (condition lives in WHERE)
     kind: str = "inner"
+    # [AS] alias — when set, it HIDES the base table name in this scope
+    # (standard SQL): qualified refs resolve via `alias`, not `table`
+    alias: str | None = None
 
 
 @dataclass
@@ -116,6 +120,8 @@ class SelectStmt:
     # FROM (SELECT ...) alias — the derived statement; `table` holds the
     # alias. Fallback-only (the planner declines derived tables).
     derived: object = None
+    # FROM <table> [AS] alias — hides the base name in this scope
+    table_alias: str | None = None
 
 
 @dataclass
@@ -160,6 +166,17 @@ class _Parser:
 
     def take_kw(self, kw):
         return self.take("kw", kw)
+
+    def _table_alias(self):
+        """[AS] alias after a FROM/JOIN table name (a bare name token —
+        keywords like WHERE/JOIN/ON end the reference, so no ambiguity).
+        Dotted names are column refs, never aliases."""
+        if self.at_kw("as"):
+            self.take()
+            return self.take("name")
+        if self.peek()[0] == "name" and "." not in self.peek()[1]:
+            return self.take("name")
+        return None
 
     # ---- statement -------------------------------------------------------
 
@@ -260,23 +277,28 @@ class _Parser:
                 else "__derived"
         else:
             stmt.table = self.take("name")
+            stmt.table_alias = self._table_alias()
         while True:
             if self.peek() == ("op", ","):
                 self.take()
-                stmt.joins.append(JoinClause(self.take("name"), None))
+                stmt.joins.append(JoinClause(self.take("name"), None,
+                                             alias=self._table_alias()))
                 continue
-            if self.at_kw("join", "inner", "left"):
+            if self.at_kw("join", "inner", "left", "right", "full"):
                 kind = "inner"
-                if self.at_kw("left"):
-                    self.take()
-                    kind = "left"
+                if self.at_kw("left", "right", "full"):
+                    kind = self.take()
+                    if self.at_kw("outer"):
+                        self.take()
                 elif self.at_kw("inner"):
                     self.take()
                 self.take_kw("join")
                 tname = self.take("name")
+                talias = self._table_alias()
                 self.take_kw("on")
                 cond = self.expr()
-                stmt.joins.append(JoinClause(tname, cond, kind))
+                stmt.joins.append(JoinClause(tname, cond, kind,
+                                             alias=talias))
                 continue
             break
         if self.at_kw("where"):
