@@ -145,6 +145,12 @@ class EngineConfig:
     # K-block tile height: group spaces wider than this tile over a second
     # grid axis ([KB, rb] one-hot per step instead of one [K, rb] tile)
     pallas_k_per_block: int = 1024
+    # the one-hot reduce does K_pad*n*H_pad*2 FLOPs — O(K·n), the wrong
+    # asymptotics for large K (docs/PERF_MODEL.md). Under "auto", plans
+    # whose product exceeds this budget keep the XLA scatter kernel;
+    # None = no cap (pre-A/B behavior; "force" always ignores the cap).
+    # Default set from the on-chip A/B once the probe banks it.
+    pallas_auto_flop_budget: float | None = None
 
     extra: dict = field(default_factory=dict)
 

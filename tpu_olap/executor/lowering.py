@@ -423,6 +423,20 @@ def _maybe_use_pallas(plan, query, table, config, filter_fn, imask_fn=None):
     if reason is not None:
         plan.pallas_reason = reason
         return
+    if config.use_pallas == "auto" and \
+            config.pallas_auto_flop_budget is not None:
+        # the one-hot reduce is O(K·n): K_pad*n*H_pad*2 FLOPs
+        # (docs/PERF_MODEL.md). Past the budget the XLA scatter kernel
+        # wins — its work is n-bound and K-free.
+        n = len(table.segments) * table.block_rows
+        kb = max(1, min(plan.total_groups, config.pallas_k_per_block))
+        k_pad = -(-plan.total_groups // kb) * kb
+        flops = 2.0 * k_pad * n * 128
+        if flops > config.pallas_auto_flop_budget:
+            plan.pallas_reason = (
+                f"auto: one-hot reduce needs {flops:.2e} FLOPs for "
+                f"K={plan.total_groups}; over pallas_auto_flop_budget")
+            return
     plan.kernel = pallas_reduce.build_kernel(plan, table, config, filter_fn,
                                              interpret=not on_tpu,
                                              imask_fn=imask_fn)
