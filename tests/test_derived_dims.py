@@ -78,6 +78,32 @@ def test_derived_stream_ledger_accounting():
     assert after > before  # derived stream is accounted, not free
 
 
+def test_calendar_bucket_stream_cached_with_parity():
+    """Calendar granularities (searchsorted over every row) cache their
+    bucket-id stream as a derived column; uniform/all kinds do not."""
+    eng = Engine()
+    df = _table()
+    eng.register_table("t", df, time_column="ts")
+    q = ("SELECT date_trunc('month', ts) AS m, sum(v) AS s FROM t "
+         "GROUP BY date_trunc('month', ts) ORDER BY m")
+    got = eng.sql(q)
+    store = _derived_store(eng)
+    assert len(store) == 1  # the monthly boundary stream
+    exp = df.assign(m=df.ts.dt.to_period("M").dt.start_time) \
+        .groupby("m", as_index=False).agg(s=("v", "sum")).sort_values("m")
+    assert [pd.Timestamp(x) for x in got["m"]] == exp["m"].tolist()
+    assert got["s"].tolist() == exp["s"].tolist()
+    # repeat run reuses, doesn't rebuild
+    tok = next(iter(store))
+    first = store[tok]
+    eng.sql(q)
+    assert store[tok] is first
+    # an hourly (uniform) granularity adds nothing
+    eng.sql("SELECT date_trunc('hour', ts) AS h, count(*) AS n FROM t "
+            "GROUP BY date_trunc('hour', ts) LIMIT 5")
+    assert len(store) == 1
+
+
 def test_pallas_auto_flop_budget_gates_large_k():
     """Under 'auto', a plan whose one-hot FLOP product exceeds the
     budget keeps the scatter kernel; 'force' ignores the budget."""

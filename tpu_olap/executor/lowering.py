@@ -351,7 +351,10 @@ def _lower_agg(query, table, config) -> PhysicalPlan:
             mask = mask & imask_fn(fenv, consts)
         ids, radix = [], []
         if bucket_plan.kind != "all":
-            ids.append(bucket_plan.ids(flat[TIME_COLUMN], consts))
+            cached = flat.get(bucket_plan.derived_name) \
+                if bucket_plan.cache_token else None
+            ids.append(cached if cached is not None
+                       else bucket_plan.ids(flat[TIME_COLUMN], consts))
             radix.append(sizes[0])
         for dp, size in zip(dim_plans, sizes[1:]):
             ids.append(dp.ids(fenv, consts, xp))

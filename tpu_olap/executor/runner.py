@@ -343,8 +343,11 @@ class QueryRunner:
         table = plan.table
         ds = self._dataset(table)
         env = ds.env(plan.columns, plan.null_cols)
+        bp = plan.bucket_plan
+        bp_token = bp.cache_token if bp is not None else None
         tokens = [dp.cache_token for dp in plan.dim_plans
-                  if dp.cache_token is not None]
+                  if dp.cache_token is not None] \
+            + ([bp_token] if bp_token else [])
         if tokens:
             # pin this query's whole working set (columns + every derived
             # stream it needs) so one derived add cannot evict another
@@ -358,6 +361,10 @@ class QueryRunner:
                         dp.cache_token,
                         lambda dp=dp: self._build_derived(ds, plan, dp),
                         pinned)
+            if bp_token:
+                env["cols"][bp.derived_name] = ds.derived(
+                    bp_token,
+                    lambda: self._build_bucket_stream(ds, plan), pinned)
         valid = ds.valid()
         seg_mask = ds.segment_mask(plan.pruned_ids if not plan.empty else [])
         metrics["segments_total"] = len(table.segments)
@@ -393,6 +400,24 @@ class QueryRunner:
             env2 = {"cols": {src: c}, "nulls": {}}
             cdev = {k: jnp.asarray(v) for k, v in consts.items()}
             return dp.ids(env2, cdev, jnp).astype(jnp.int32)
+
+        return jax.jit(f)(col)
+
+    def _build_bucket_stream(self, ds, plan: PhysicalPlan):
+        """Calendar-granularity bucket ids [S, R] int32: the searchsorted
+        over every row is paid once per (table, boundary set), not per
+        dispatch."""
+        col = ds.col(TIME_COLUMN)
+        consts = plan.pool.consts
+        if self.config.platform == "cpu":
+            return np.asarray(plan.bucket_plan.ids(np.asarray(col), consts),
+                              np.int32)
+        import jax
+        import jax.numpy as jnp
+
+        def f(c):
+            cdev = {k: jnp.asarray(v) for k, v in consts.items()}
+            return plan.bucket_plan.ids(c, cdev).astype(jnp.int32)
 
         return jax.jit(f)(col)
 

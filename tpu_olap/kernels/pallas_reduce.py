@@ -571,7 +571,11 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
             if imask_fn is not None:
                 mask = mask & imask_fn(flat_env, consts)
             if has_buckets:
-                b = bucket_plan.ids(flat_env["cols"][TIME_COLUMN], consts)
+                b = flat_env["cols"].get(bucket_plan.derived_name) \
+                    if bucket_plan.cache_token else None
+                if b is None:
+                    b = bucket_plan.ids(flat_env["cols"][TIME_COLUMN],
+                                        consts)
                 pre_in.append(b.astype(jnp.int32).reshape(1, n))
             for dp, is_pre in zip(dim_plans, pre_dims):
                 if is_pre:

@@ -36,6 +36,15 @@ class BucketPlan:
     origin_name: str | None = None
     step_name: str | None = None
     boundaries_name: str | None = None
+    # content hash for the "boundaries" kind: a per-dispatch searchsorted
+    # over every row is the calendar-granularity hot cost; the runner
+    # caches the resulting id stream as a device-resident derived column
+    # keyed by this token (same machinery as remap dims)
+    cache_token: str | None = None
+
+    @property
+    def derived_name(self) -> str | None:
+        return None if self.cache_token is None else "\0b:" + self.cache_token
 
     def ids(self, time, consts):
         xp = jnp if not isinstance(time, np.ndarray) else np
@@ -101,8 +110,11 @@ def compile_granularity(gran: Granularity, t_min: int, t_max: int,
         bs = np.asarray(timeutil.calendar_boundaries(
             gran.period, gran.time_zone, t_min, t_max), np.int64)
         n = len(bs) - 1
+        import hashlib
         return BucketPlan(n, bs[:-1], "boundaries",
-                          boundaries_name=pool.add(bs))
+                          boundaries_name=pool.add(bs),
+                          cache_token=hashlib.sha1(
+                              bs.tobytes()).hexdigest()[:16])
     raise UnsupportedGranularity(f"unknown granularity {gran!r}")
 
 
