@@ -208,6 +208,52 @@ def test_q22_cte_over_aggregate(eng):
         ORDER BY c_nation""", False)
 
 
+def test_q17_small_quantity_revenue_correlated(eng):
+    """Q17 proper: revenue from rows under 20% of the part-brand average
+    quantity — the equality-correlated scalar-aggregate shape, now
+    decorrelated into a key->value map (round 4) instead of rejected."""
+    df = _olps()
+    got = eng.sql(
+        "SELECT sum(l_extendedprice) AS rev FROM olps "
+        "WHERE l_quantity < (SELECT 0.2 * avg(o2.l_quantity) FROM olps o2 "
+        "WHERE o2.p_brand = olps.p_brand)")
+    assert not eng.last_plan.rewritten  # fallback serves it
+    avg = df.groupby("p_brand")["l_quantity"].mean()
+    m = df["l_quantity"] < 0.2 * df["p_brand"].map(avg)
+    assert int(got["rev"][0]) == int(df.loc[m, "l_extendedprice"].sum())
+
+
+def test_q21_exists_not_exists_correlated(eng):
+    """Q21 shape: semi-join + anti-join via correlated EXISTS/NOT
+    EXISTS."""
+    df = _olps()
+    got = eng.sql(
+        "SELECT count(*) AS n FROM olps WHERE "
+        "EXISTS (SELECT 1 FROM olps o2 WHERE o2.p_brand = olps.p_brand "
+        "AND o2.l_shipmode = 'AIR' AND o2.l_quantity > 45) "
+        "AND NOT EXISTS (SELECT 1 FROM olps o3 "
+        "WHERE o3.p_brand = olps.p_brand AND o3.l_discount = 10 "
+        "AND o3.p_size > 48)")
+    air = set(df.loc[(df.l_shipmode == "AIR")
+                     & (df.l_quantity > 45), "p_brand"])
+    d10 = set(df.loc[(df.l_discount == 10) & (df.p_size > 48), "p_brand"])
+    exp = int((df.p_brand.isin(air) & ~df.p_brand.isin(d10)).sum())
+    assert int(got["n"][0]) == exp
+
+
+def test_q2_correlated_minimum(eng):
+    """Q2 shape: rows whose value equals a two-key correlated minimum."""
+    df = _olps()
+    got = eng.sql(
+        "SELECT count(*) AS n FROM olps WHERE l_extendedprice = "
+        "(SELECT min(o2.l_extendedprice) FROM olps o2 "
+        "WHERE o2.p_brand = olps.p_brand "
+        "AND o2.s_region = olps.s_region)")
+    mn = df.groupby(["p_brand", "s_region"])["l_extendedprice"] \
+        .transform("min")
+    assert int(got["n"][0]) == int((df.l_extendedprice == mn).sum())
+
+
 def test_monthly_timeseries(eng):
     """Granularity bucketing over the order date (the reference's
     date-function suites)."""
