@@ -174,6 +174,70 @@ def test_aliased_self_join_rejected_not_wrong(eng):
                  JOIN fact b ON a.k = b.k LIMIT 5""")
 
 
+def test_cross_join_and_using(eng):
+    e, fact, dim = eng
+    got = e.sql("SELECT count(*) AS n FROM fact CROSS JOIN dim")
+    assert int(got["n"].iloc[0]) == len(fact) * len(dim)
+    e.register_table("dim2", pd.DataFrame(
+        {"k": [1, 2, 3], "tag": ["a", "b", "c"]}), accelerate=False)
+    got = e.sql("SELECT tag, count(*) AS n FROM fact "
+                "JOIN dim2 USING (k) GROUP BY tag ORDER BY tag")
+    exp = fact.merge(pd.DataFrame({"k": [1, 2, 3],
+                                   "tag": ["a", "b", "c"]}), on="k") \
+        .groupby("tag", as_index=False).size()
+    assert got["n"].tolist() == exp["size"].tolist()
+
+
+def test_multi_column_using(eng):
+    """USING (a, b) must join on BOTH columns, not the first plus a
+    tautology."""
+    e, _, _ = eng
+    left = pd.DataFrame({"a": [1, 1, 2], "b": [10, 20, 30],
+                         "x": ["p", "q", "r"]})
+    right = pd.DataFrame({"a": [1, 1, 2], "b": [10, 99, 30],
+                          "y": ["s", "t", "u"]})
+    e.register_table("ml", left, accelerate=False)
+    e.register_table("mr", right, accelerate=False)
+    got = e.sql("SELECT x, y FROM ml JOIN mr USING (a, b) ORDER BY x")
+    assert got["x"].tolist() == ["p", "r"]
+    assert got["y"].tolist() == ["s", "u"]
+
+
+def test_scalar_functions_and_concat_operator(eng):
+    e, fact, _ = eng
+    got = e.sql("SELECT coalesce(NULLIF(grp, 'a'), 'zz') AS g2, "
+                "length(grp) AS ln, replace(grp, 'b', 'B') AS r, "
+                "grp || '!' AS bang, EXTRACT(YEAR FROM ts) AS y "
+                "FROM fact LIMIT 3")
+    assert set(got.columns) == {"g2", "ln", "r", "bang", "y"}
+    assert (got["ln"] == 1).all()
+    assert got["bang"].str.endswith("!").all()
+    assert (got["y"] == 2024).all()
+    assert not (got["g2"] == "a").any()  # 'a' nullified then coalesced
+
+
+def test_nulls_first_last_honored(eng):
+    e, _, _ = eng
+    df = pd.DataFrame({"x": [3, None, 1, None, 2],
+                       "tag": list("abcde")})
+    e.register_table("nt", df, accelerate=False)
+    last = e.sql("SELECT tag FROM nt ORDER BY x ASC NULLS LAST")
+    assert last["tag"].tolist() == ["c", "e", "a", "b", "d"]
+    first = e.sql("SELECT tag FROM nt ORDER BY x DESC NULLS FIRST")
+    assert first["tag"].tolist() == ["b", "d", "a", "e", "c"]
+    # the device path declines the explicit spelling (fallback serves it)
+    got = e.sql("SELECT grp, count(*) AS n FROM fact GROUP BY grp "
+                "ORDER BY n DESC NULLS LAST LIMIT 2")
+    assert not e.last_plan.rewritten
+    # a spelling on one key must not flip the placement of another,
+    # unspelled key (both x-nulls stay LAST, per this path's default)
+    df2 = pd.DataFrame({"x": [3, None, 1, None, 2],
+                        "y": [1, 2, 3, 4, 5], "tag": list("abcde")})
+    e.register_table("nt2", df2, accelerate=False)
+    got = e.sql("SELECT tag FROM nt2 ORDER BY x ASC, y ASC NULLS LAST")
+    assert got["tag"].tolist() == ["c", "e", "a", "b", "d"]
+
+
 def test_non_equality_correlation_still_legible(eng):
     e, _, _ = eng
     with pytest.raises(Exception, match="correlat|not supported"):

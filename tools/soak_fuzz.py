@@ -52,11 +52,7 @@ def run_seed(seed: int):
     return "ok", sql
 
 
-def main():
-    start = int(os.environ.get("SOAK_SEED_START", 0))
-    n = int(os.environ.get("SOAK_N", 1000))
-    tag = os.environ.get("SOAK_TAG", "r04")
-    t0 = time.time()
+def _run_range(start: int, n: int):
     counts = {"ok": 0, "fallback": 0, "fail": 0, "error": 0}
     failures = []
     for seed in range(start, start + n):
@@ -69,12 +65,59 @@ def main():
             failures.append({"seed": seed,
                              "error": f"{type(err).__name__}: {err}"[:800]})
         if (seed - start + 1) % 100 == 0:
-            print(f"[soak] {seed - start + 1}/{n} counts={counts}",
+            print(f"[soak] seeds {start}..{seed} counts={counts}",
                   file=sys.stderr, flush=True)
+    return counts, failures
+
+
+def main():
+    start = int(os.environ.get("SOAK_SEED_START", 0))
+    n = int(os.environ.get("SOAK_N", 1000))
+    tag = os.environ.get("SOAK_TAG", "r04")
+    chunk = int(os.environ.get("SOAK_CHUNK", 100))
+    t0 = time.time()
+
+    if os.environ.get("SOAK_INLINE"):
+        counts, failures = _run_range(start, n)
+        print(json.dumps({"counts": counts, "failures": failures}))
+        return 1 if failures else 0
+
+    # chunked in subprocesses: every seed compiles fresh XLA executables
+    # into process-global caches, so a single 1000-seed process grows
+    # without bound (observed: OOM-killed at 127 GB RSS around seed 200)
+    import subprocess
+    counts = {"ok": 0, "fallback": 0, "fail": 0, "error": 0}
+    failures = []
+    done = 0
+    while done < n:
+        m = min(chunk, n - done)
+        env = dict(os.environ)
+        env.update({"SOAK_INLINE": "1",
+                    "SOAK_SEED_START": str(start + done),
+                    "SOAK_N": str(m)})
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True, cwd=REPO)
+        line = proc.stdout.strip().splitlines()[-1] \
+            if proc.stdout.strip() else ""
+        if line.startswith("{"):
+            rec = json.loads(line)
+            for k, v in rec["counts"].items():
+                counts[k] += v
+            failures.extend(rec["failures"])
+        else:
+            counts["error"] += m
+            failures.append({"seed": start + done,
+                             "error": "chunk crashed: "
+                             + proc.stderr[-500:]})
+        done += m
+        print(f"[soak] {done}/{n} counts={counts}",
+              file=sys.stderr, flush=True)
     out = {
         "seed_start": start, "n": n,
         "seed_derivation": "default_rng(1000 + seed), CI-identical",
         "counts": counts, "failures": failures,
+        "chunk_seeds_per_process": chunk,
         "wall_s": round(time.time() - t0, 1),
         "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }

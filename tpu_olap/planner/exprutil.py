@@ -154,9 +154,11 @@ def map_stmt_exprs(stmt, fn):
     out.where = fn(stmt.where) if stmt.where is not None else None
     out.having = fn(stmt.having) if stmt.having is not None else None
     out.group_by = [fn(g) for g in stmt.group_by]
-    out.joins = [type(j)(j.table, fn(j.on) if j.on is not None else None,
-                         j.kind) for j in stmt.joins]
-    out.order_by = [type(o)(fn(o.expr), o.descending)
+    import dataclasses
+    out.joins = [dataclasses.replace(
+        j, on=fn(j.on) if j.on is not None else None)
+        for j in stmt.joins]
+    out.order_by = [dataclasses.replace(o, expr=fn(o.expr))
                     for o in stmt.order_by]
     return out
 

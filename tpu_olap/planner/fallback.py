@@ -88,16 +88,17 @@ def execute_fallback(stmt, catalog, config) -> pd.DataFrame:
 
     if stmt.order_by and not (group_exprs or has_agg):
         keys, ascending = [], []
-        for item in stmt.order_by:
+        for i, item in enumerate(stmt.order_by):
             name = _auto_name(item.expr)
             col = name if name in out.columns else None
             if col is None:
-                out["__sort"] = _eval(item.expr, df, time_col).to_numpy()
-                col = "__sort"
+                col = f"__sort{i}"  # indexed: two computed keys coexist
+                out[col] = _eval(item.expr, df, time_col).to_numpy()
             keys.append(col)
             ascending.append(not item.descending)
-        out = out.sort_values(keys, ascending=ascending, kind="stable")
-        out = out.drop(columns=[c for c in ("__sort",) if c in out.columns])
+        out = _sort_order_items(out, keys, stmt.order_by,
+                                default_low=False)
+        out = out.drop(columns=[c for c in keys if c.startswith("__sort")])
     lo = stmt.offset
     hi = None if stmt.limit is None else lo + stmt.limit
     return out.iloc[lo:hi].reset_index(drop=True)
@@ -140,8 +141,7 @@ def _execute_union(stmt: UnionStmt, catalog, config) -> pd.DataFrame:
                     f"UNION ORDER BY {name!r} is not an output column")
             keys.append(name)
             ascending.append(not item.descending)
-        out = out.sort_values(keys, ascending=ascending, kind="stable",
-                              key=_null_low_key)
+        out = _sort_order_items(out, keys, stmt.order_by)
     lo = stmt.offset
     hi = None if stmt.limit is None else lo + stmt.limit
     return out.iloc[lo:hi].reset_index(drop=True)
@@ -618,6 +618,23 @@ def _join_and_filter(stmt, df, catalog, time_col):
         still = []
         for j in pending:
             other = catalog.get(j.table).frame
+            if j.kind == "cross":
+                df = df.merge(other, how="cross",
+                              suffixes=("", f"__{j.table}"))
+                continue
+            if j.using is not None:
+                missing = [c for c in j.using
+                           if c not in df.columns or c not in other.columns]
+                if missing:
+                    raise FallbackError(
+                        f"USING column(s) {missing} not on both sides of "
+                        f"the join with {j.table!r}")
+                # merge on the full column list: pandas coalesces the
+                # same-named keys, matching SQL USING output
+                df = df.merge(other, on=list(j.using),
+                              how=_JOIN_HOW[j.kind],
+                              suffixes=("", f"__{j.table}"))
+                continue
             conds = _split_and(j.on) if j.on is not None else where_conjs
             pair = None
             for c in conds:
@@ -804,8 +821,7 @@ def _aggregate(df, exprs, out_names, group_exprs, stmt, time_col):
     out = pd.DataFrame(rows, columns=out_names + list(order_exprs))
 
     if order_cols:
-        out = out.sort_values(order_cols, ascending=ascending,
-                              kind="stable", key=_null_low_key)
+        out = _sort_order_items(out, order_cols, stmt.order_by)
     return out[out_names].reset_index(drop=True)
 
 
@@ -949,9 +965,8 @@ def _chunked_scan(stmt, chunks, exprs, out_names, catalog, time_col,
     if stmt.order_by:
         keys = [(_auto_name(i.expr) if _auto_name(i.expr) in out_names
                  else f"__s{j}") for j, i in enumerate(stmt.order_by)]
-        out = out.sort_values(
-            keys, ascending=[not i.descending for i in stmt.order_by],
-            kind="stable")
+        out = _sort_order_items(out, keys, stmt.order_by,
+                                default_low=False)
     elif time_sort and "__t" in out.columns:
         out = out.sort_values("__t", kind="stable")
     lo = stmt.offset
@@ -1360,12 +1375,42 @@ def _chunked_aggregate(stmt, chunks, exprs, out_names, group_exprs,
             rows.append(rec)
         out = pd.DataFrame(rows, columns=out_names + list(order_exprs))
     if order_cols:
-        out = out.sort_values(order_cols, ascending=ascending,
-                              kind="stable", key=_null_low_key)
+        out = _sort_order_items(out, order_cols, stmt.order_by)
     out = out[out_names].reset_index(drop=True)
     lo = stmt.offset
     hi = None if stmt.limit is None else lo + stmt.limit
     return out.iloc[lo:hi].reset_index(drop=True)
+
+
+def _sort_order_items(out: pd.DataFrame, cols: list, items: list,
+                      default_low: bool = True) -> pd.DataFrame:
+    """THE ORDER BY sorter for every fallback path: multi-key stable
+    sort via successive stable single-key sorts (last key first),
+    honoring per-key NULLS FIRST/LAST. A key without a spelling takes
+    the site default: nulls-low (`default_low=True`, matching the device
+    path's null placement) or pandas-plain (nulls last in both
+    directions — the historical scan-path behavior). Keeping one helper
+    prevents the per-site copies from drifting (a missed site silently
+    ignored the spelling; split defaults flipped unspelled keys)."""
+    for col, item in list(zip(cols, items))[::-1]:
+        asc = not item.descending
+        keyed = _null_low_key(out[col])
+        out = out.loc[keyed.sort_values(ascending=asc,
+                                        kind="stable").index]
+        if item.nulls is not None:
+            want_first = item.nulls == "first"
+        elif default_low:
+            want_first = asc       # nulls-low: already where they landed
+        else:
+            want_first = False     # pandas default: nulls last either way
+        nulls_first_now = asc      # the nulls-low key put them here
+        if want_first != nulls_first_now:
+            m = pd.isna(out[col]).to_numpy()
+            if m.any():
+                parts = (out[m], out[~m]) if want_first \
+                    else (out[~m], out[m])
+                out = pd.concat(parts)
+    return out
 
 
 def _null_low_key(s: pd.Series) -> pd.Series:
@@ -1504,6 +1549,31 @@ def _eval(e, df, time_col):
                     {"month": "M", "quarter": "Q", "year": "Y",
                      "week": "W-SUN"}[unit]).dt.start_time
             return t.dt.floor(freq)
+        if fn == "coalesce":
+            out = None
+            for a in e.args:
+                v = _eval(a, df, time_col)
+                if not isinstance(v, pd.Series):
+                    v = pd.Series([v] * len(df), index=df.index)
+                out = v if out is None else out.where(out.notna(), v)
+            return out
+        if fn == "nullif":
+            a = _eval(e.args[0], df, time_col)
+            b = _eval(e.args[1], df, time_col)
+            if not isinstance(a, pd.Series):
+                a = pd.Series([a] * len(df), index=df.index)
+            return a.mask(pd.Series(a == b, index=a.index).fillna(False))
+        if fn in ("length", "char_length"):
+            s = _as_str_series(_eval(e.args[0], df, time_col), df, fn)
+            return s.str.len()
+        if fn == "replace":
+            if not (len(e.args) == 3 and isinstance(e.args[1], Lit)
+                    and isinstance(e.args[2], Lit)):
+                raise FallbackError(
+                    "replace() needs literal search/replacement strings")
+            s = _as_str_series(_eval(e.args[0], df, time_col), df, fn)
+            return s.str.replace(str(e.args[1].value),
+                                 str(e.args[2].value), regex=False)
         if fn in ("upper", "lower", "trim"):
             s = _as_str_series(_eval(e.args[0], df, time_col), df, fn)
             if fn == "upper":

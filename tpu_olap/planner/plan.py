@@ -247,6 +247,8 @@ class _Rewriter:
         stmt = self.stmt
         if not stmt.joins:
             return conjuncts
+        if any(j.using is not None for j in stmt.joins):
+            raise RewriteError("USING joins execute on the fallback path")
         star = self.entry.star
         if star is None:
             raise RewriteError("join query but no star schema declared")
@@ -1055,6 +1057,10 @@ class _Rewriter:
             by_source.setdefault(o.source, o.source)
         cols = []
         for item in stmt.order_by:
+            if item.nulls is not None:
+                raise RewriteError(
+                    "explicit NULLS FIRST/LAST ordering executes on the "
+                    "fallback path")
             e = self._resolve(item.expr)
             key = _key(e)
             if key in self._agg_by_key:

@@ -33,14 +33,14 @@ _TOKEN = re.compile(r"""
       (?P<num>\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)
     | (?P<name>[^\W\d]\w*(?:\.[^\W\d]\w*)?)
     | (?P<str>'(?:[^']|'')*')
-    | (?P<op><>|!=|<=|>=|=|<|>|\(|\)|,|\*|\+|-|/|%)
+    | (?P<op>\|\||<>|!=|<=|>=|=|<|>|\(|\)|,|\*|\+|-|/|%)
     )""", re.VERBOSE)
 
 _KEYWORDS = {
     "select", "from", "where", "group", "by", "having", "order", "limit",
     "offset", "as", "and", "or", "not", "between", "in", "like", "is",
     "null", "asc", "desc", "join", "inner", "left", "right", "full",
-    "outer", "on", "distinct",
+    "outer", "cross", "on", "using", "nulls", "distinct",
     "case", "when", "then", "else", "end", "cast", "union", "all", "with",
     "intersect", "except", "exists",
 }
@@ -97,12 +97,21 @@ class JoinClause:
     # [AS] alias — when set, it HIDES the base table name in this scope
     # (standard SQL): qualified refs resolve via `alias`, not `table`
     alias: str | None = None
+    # JOIN ... USING (a, b): same-named multi-column equi keys. Kept as
+    # a column tuple, NOT synthesized `Col(a)==Col(a)` conditions — an
+    # unqualified self-equality is a tautology after qualifier stripping
+    # (it would silently join on nothing)
+    using: tuple | None = None
 
 
 @dataclass
 class OrderItem:
     expr: Expr
     descending: bool = False
+    # NULLS FIRST|LAST (None = the engine default: nulls sort low).
+    # Honored by the fallback sorter; the device rewriter declines
+    # non-default spellings so they fall back rather than mis-sort.
+    nulls: str | None = None
 
 
 @dataclass
@@ -284,6 +293,13 @@ class _Parser:
                 stmt.joins.append(JoinClause(self.take("name"), None,
                                              alias=self._table_alias()))
                 continue
+            if self.at_kw("cross"):
+                self.take()
+                self.take_kw("join")
+                stmt.joins.append(JoinClause(self.take("name"), None,
+                                             "cross",
+                                             alias=self._table_alias()))
+                continue
             if self.at_kw("join", "inner", "left", "right", "full"):
                 kind = "inner"
                 if self.at_kw("left", "right", "full"):
@@ -295,6 +311,18 @@ class _Parser:
                 self.take_kw("join")
                 tname = self.take("name")
                 talias = self._table_alias()
+                if self.at_kw("using"):
+                    self.take()
+                    self.take("op", "(")
+                    ucols = [self.take("name")]
+                    while self.peek() == ("op", ","):
+                        self.take()
+                        ucols.append(self.take("name"))
+                    self.take("op", ")")
+                    stmt.joins.append(JoinClause(
+                        tname, None, kind, alias=talias,
+                        using=tuple(ucols)))
+                    continue
                 self.take_kw("on")
                 cond = self.expr()
                 stmt.joins.append(JoinClause(tname, cond, kind,
@@ -317,7 +345,7 @@ class _Parser:
         if self.at_kw("order"):
             self.take()
             self.take_kw("by")
-            stmt.order_by = [OrderItem(e, d) for e, d in
+            stmt.order_by = [OrderItem(e, d, n) for e, d, n in
                              self._order_items()]
         if self.at_kw("limit"):
             self.take()
@@ -433,9 +461,13 @@ class _Parser:
 
     def add(self):
         e = self.mul()
-        while self.peek()[0] == "op" and self.peek()[1] in ("+", "-"):
+        while self.peek()[0] == "op" and self.peek()[1] in ("+", "-",
+                                                            "||"):
             op = self.take()
-            e = BinOp(op, e, self.mul())
+            if op == "||":  # SQL string concatenation
+                e = FuncCall("concat", (e, self.mul()))
+            else:
+                e = BinOp(op, e, self.mul())
         return e
 
     def mul(self):
@@ -488,6 +520,16 @@ class _Parser:
             if self.peek() == ("op", "("):
                 self.take()
                 fname = v.lower()
+                if fname == "extract":
+                    # EXTRACT(YEAR FROM ts) -> year(ts) etc.
+                    unit = str(self.take("name")).lower()
+                    if unit not in ("year", "quarter", "month", "day",
+                                    "hour", "minute", "second"):
+                        raise SqlError(f"EXTRACT unit {unit!r}")
+                    self.take_kw("from")
+                    arg = self.expr()
+                    self.take("op", ")")
+                    return FuncCall(unit, (arg,))
                 distinct = False
                 if self.at_kw("distinct"):
                     self.take()
@@ -552,12 +594,18 @@ class _Parser:
         if self.at_kw("order"):
             self.take()
             self.take_kw("by")
-            order = self._order_items()
+            items = self._order_items()
+            if any(n for _, _, n in items):
+                raise SqlError(
+                    "NULLS FIRST/LAST in a window ORDER BY is not "
+                    "supported")
+            order = [(e, d) for e, d, _ in items]
         self.take("op", ")")
         return WindowCall(fname, args, tuple(partition), tuple(order))
 
     def _order_items(self) -> list:
-        """Comma list of `expr [ASC|DESC]` -> [(expr, descending)]."""
+        """Comma list of `expr [ASC|DESC] [NULLS FIRST|LAST]` ->
+        [(expr, descending, nulls|None)]."""
         out = []
         while True:
             e = self.expr()
@@ -567,7 +615,13 @@ class _Parser:
             elif self.at_kw("desc"):
                 self.take()
                 desc = True
-            out.append((e, desc))
+            nulls = None
+            if self.at_kw("nulls"):
+                self.take()
+                nulls = str(self.take("name")).lower()
+                if nulls not in ("first", "last"):
+                    raise SqlError(f"NULLS {nulls!r}: expected FIRST|LAST")
+            out.append((e, desc, nulls))
             if self.peek() == ("op", ","):
                 self.take()
                 continue
