@@ -172,6 +172,8 @@ def main():
     digests = {}
 
     detail = {}
+    spread = {}  # per-query min/max over the timed iters (VERDICT r3
+    #              weak #2: single-sample artifacts need variance data)
     for qname in sorted(QUERIES):
         sql = QUERIES[qname]
         # Warm twice: the first run compiles and observes the true group
@@ -191,7 +193,10 @@ def main():
             eng.sql(sql)
             times.append((time.perf_counter() - t0) * 1000)
         detail[qname] = round(float(np.percentile(times, 50)), 3)
-        note(f"{qname} p50={detail[qname]}ms")
+        spread[qname] = {"min": round(min(times), 3),
+                         "max": round(max(times), 3)}
+        note(f"{qname} p50={detail[qname]}ms "
+             f"[{spread[qname]['min']}..{spread[qname]['max']}]")
 
     ledger = eng.runner._hbm_ledger
     worst = max(detail.values())
@@ -204,6 +209,8 @@ def main():
             "rows": rows, "backend": backend,
             "use_pallas": use_pallas,
             "per_query_p50_ms": detail,
+            "per_query_spread_ms": spread,
+            "iters": iters,
             "ram_cap_gb": cap_gb,
             "generate_s": round(gen_s, 1),
             "ingest_s": round(ingest_s, 1),
