@@ -31,3 +31,6 @@ def test_two_process_distributed_psum():
     assert len(art["workers"]) == 2
     for w in art["workers"]:
         assert w["psum_total"] == w["expect"]
+        # a REAL engine GROUP BY ran SPMD on both processes and matched
+        # the pandas oracle on each
+        assert w["engine_query_ok"] is True

@@ -80,9 +80,41 @@ def worker(pid: int) -> None:
     local_parts = [int(np.asarray(s.data).reshape(-1)[0])
                    for s in out["parts"].addressable_shards]
     assert len(local_parts) == DEVS_PER_PROC
+
+    # phase 2: a REAL engine query, SPMD across the two processes — both
+    # run the identical program over the same registered table; the
+    # sharded dispatch's psum merge rides the multi-host mesh and every
+    # host assembles the same replicated answer
+    import pandas as pd
+    from tpu_olap import Engine
+    from tpu_olap.executor import EngineConfig
+    rng = np.random.default_rng(23)
+    rows_t = 4096
+    df = pd.DataFrame({
+        "ts": pd.to_datetime("2024-03-01")
+        + pd.to_timedelta(rng.integers(0, 86400 * 20, rows_t), unit="s"),
+        "g": rng.choice(["a", "b", "c", "d"], rows_t),
+        "v": rng.integers(0, 1000, rows_t).astype(np.int64),
+    })
+    eng = Engine(EngineConfig(num_shards=n_dev))
+    eng.register_table("t", df, time_column="ts", block_rows=256)
+    q = ("SELECT g, sum(v) AS s, count(*) AS n FROM t "
+         "WHERE v < 900 GROUP BY g ORDER BY g")
+    res = eng.sql(q)
+    sub = df[df.v < 900]
+    exp_df = sub.groupby("g", as_index=False).agg(
+        s=("v", "sum"), n=("v", "size")).sort_values("g")
+    engine_ok = (res["g"].tolist() == exp_df["g"].tolist()
+                 and res["s"].tolist() == exp_df["s"].tolist()
+                 and res["n"].tolist() == exp_df["n"].tolist())
+    assert engine_ok, (res, exp_df)
+
     print(json.dumps({"pid": pid, "devices": n_dev,
                       "local_devices": n_local, "psum_total": total,
-                      "expect": expect, "ok": total == expect}))
+                      "expect": expect,
+                      "engine_query_ok": engine_ok,
+                      "engine_rows": len(res),
+                      "ok": total == expect and engine_ok}))
     jax.distributed.shutdown()
 
 
