@@ -238,6 +238,76 @@ def test_nulls_first_last_honored(eng):
     assert got["tag"].tolist() == ["c", "e", "a", "b", "d"]
 
 
+def test_rollup(eng):
+    e, fact, _ = eng
+    got = e.sql("SELECT grp, k, sum(v) AS s FROM fact "
+                "GROUP BY ROLLUP(grp, k) ORDER BY grp, k")
+    detail = fact.groupby(["grp", "k"])["v"].sum()
+    per_grp = fact.groupby("grp")["v"].sum()
+    total = fact["v"].sum()
+    assert len(got) == len(detail) + len(per_grp) + 1
+    grand = got[got["grp"].isna() & got["k"].isna()]
+    assert len(grand) == 1 and int(grand["s"].iloc[0]) == int(total)
+    sub = got[got["grp"].notna() & got["k"].isna()]
+    assert {(r.grp, int(r.s)) for r in sub.itertuples()} \
+        == {(g, int(v)) for g, v in per_grp.items()}
+
+
+def test_cube_and_grouping_sets(eng):
+    e, fact, _ = eng
+    cube = e.sql("SELECT grp, k, count(*) AS n FROM fact "
+                 "GROUP BY CUBE(grp, k)")
+    n_detail = fact.groupby(["grp", "k"]).ngroups
+    n_grp = fact["grp"].nunique()
+    n_k = fact["k"].nunique()
+    assert len(cube) == n_detail + n_grp + n_k + 1
+    gs = e.sql("SELECT grp, k, count(*) AS n FROM fact "
+               "GROUP BY GROUPING SETS ((grp), (k), ())")
+    assert len(gs) == n_grp + n_k + 1
+    # HAVING filters within each set
+    hv = e.sql("SELECT grp, count(*) AS n FROM fact "
+               "GROUP BY GROUPING SETS ((grp), ()) HAVING count(*) > 0")
+    assert len(hv) == n_grp + 1
+    # GROUPING() distinguishes rollup NULLs from data NULLs
+    gm = e.sql("SELECT grp, GROUPING(grp) AS gg, count(*) AS n FROM fact "
+               "GROUP BY ROLLUP(grp) ORDER BY gg, grp")
+    assert gm["gg"].tolist() == [0] * n_grp + [1]
+    assert gm[gm["gg"] == 1]["grp"].isna().all()
+    # ordinals resolve inside the construct
+    ro = e.sql("SELECT grp, k, sum(v) AS s FROM fact "
+               "GROUP BY ROLLUP(1, 2)")
+    assert len(ro) == fact.groupby(["grp", "k"]).ngroups \
+        + fact["grp"].nunique() + 1
+    # a plain column literally named 'cube' still groups normally
+    e.register_table("t3", pd.DataFrame({"cube": ["x", "y", "x"],
+                                         "v": [1, 2, 3]}),
+                     accelerate=False)
+    pc = e.sql("SELECT cube, sum(v) AS s FROM t3 GROUP BY cube "
+               "ORDER BY cube")
+    assert pc["cube"].tolist() == ["x", "y"]
+    assert pc["s"].tolist() == [4, 2]
+
+
+def test_lag_lead_window(eng):
+    e, _, _ = eng
+    df = pd.DataFrame({"p": ["a", "a", "a", "b", "b"],
+                       "o": [1, 2, 3, 1, 2],
+                       "v": [10, 20, 30, 40, 50]})
+    e.register_table("w", df, accelerate=False)
+    got = e.sql("SELECT p, o, lag(v) OVER (PARTITION BY p ORDER BY o) "
+                "AS prev, lead(v, 1, -1) OVER (PARTITION BY p ORDER BY o)"
+                " AS nxt FROM w ORDER BY p, o")
+    exp_prev = df.sort_values(["p", "o"]).groupby("p")["v"].shift(1)
+    assert [None if pd.isna(x) else x for x in got["prev"]] \
+        == [None if pd.isna(x) else int(x) for x in exp_prev]
+    # lead default -1 fills the partition tail, not data nulls
+    assert got["nxt"].tolist() == [20, 30, -1, 50, -1]
+    # offset 0 is the identity, not offset 1
+    z = e.sql("SELECT lag(v, 0) OVER (PARTITION BY p ORDER BY o) AS z "
+              "FROM w ORDER BY p, o")
+    assert z["z"].tolist() == [10, 20, 30, 40, 50]
+
+
 def test_non_equality_correlation_still_legible(eng):
     e, _, _ = eng
     with pytest.raises(Exception, match="correlat|not supported"):

@@ -160,6 +160,9 @@ def map_stmt_exprs(stmt, fn):
         for j in stmt.joins]
     out.order_by = [dataclasses.replace(o, expr=fn(o.expr))
                     for o in stmt.order_by]
+    if getattr(stmt, "grouping_sets", None) is not None:
+        out.grouping_sets = [[fn(e) for e in s]
+                             for s in stmt.grouping_sets]
     return out
 
 

@@ -79,7 +79,10 @@ def execute_fallback(stmt, catalog, config) -> pd.DataFrame:
     if stmt.distinct and not has_agg and not group_exprs:
         group_exprs = list(exprs)
 
-    if group_exprs or has_agg:
+    if stmt.grouping_sets is not None:
+        out = _grouping_sets_aggregate(df, exprs, out_names, stmt,
+                                       time_col)
+    elif group_exprs or has_agg:
         out = _aggregate(df, exprs, out_names, group_exprs, stmt, time_col)
     else:
         out = pd.DataFrame(
@@ -670,6 +673,53 @@ def _join_and_filter(stmt, df, catalog, time_col):
     return df
 
 
+def _grouping_sets_aggregate(df, exprs, out_names, stmt, time_col):
+    """GROUP BY ROLLUP/CUBE/GROUPING SETS (the reference served these
+    via full Spark SQL, SURVEY.md §3.1): one _aggregate pass per
+    grouping set with the ABSENT group keys projected as NULL literals,
+    results unioned, then ORDER BY/LIMIT over the union (applied here,
+    not per set — standard SQL). HAVING filters inside each pass."""
+    import dataclasses as _dc
+    full_keys = {_k(g) for g in stmt.group_by}
+    inner = _dc.replace(stmt, order_by=[], limit=None, offset=0)
+
+    def per_set(e, gkeys):
+        """Projection expr for one grouping set: absent group keys
+        become NULL literals, GROUPING(key) becomes 0/1."""
+        if isinstance(e, FuncCall) and e.name == "grouping" \
+                and len(e.args) == 1:
+            return Lit(0 if _k(e.args[0]) in gkeys else 1)
+        if _k(e) in full_keys and _k(e) not in gkeys:
+            return Lit(None)
+        if isinstance(e, BinOp):
+            return BinOp(e.op, per_set(e.left, gkeys),
+                         per_set(e.right, gkeys))
+        if isinstance(e, FuncCall) and e.name not in AGG_FUNCS:
+            return FuncCall(e.name, tuple(per_set(a, gkeys)
+                                          for a in e.args))
+        return e
+
+    parts = []
+    for gset in stmt.grouping_sets:
+        gkeys = {_k(g) for g in gset}
+        sub_exprs = [per_set(e, gkeys) for e in exprs]
+        parts.append(_aggregate(df, sub_exprs, out_names, list(gset),
+                                inner, time_col))
+    out = pd.concat(parts, ignore_index=True) if parts \
+        else pd.DataFrame(columns=out_names)
+    if stmt.order_by:
+        keys = []
+        for item in stmt.order_by:
+            name = _auto_name(item.expr)
+            if name not in out.columns:
+                raise FallbackError(
+                    "ORDER BY over GROUPING SETS must reference output "
+                    f"columns ({name!r} is not one)")
+            keys.append(name)
+        out = _sort_order_items(out, keys, stmt.order_by)
+    return out.reset_index(drop=True)
+
+
 def _aggregate(df, exprs, out_names, group_exprs, stmt, time_col):
     gkeys = {}
     gname_of = {}
@@ -858,6 +908,11 @@ def _execute_chunked(stmt: SelectStmt, entry, catalog, config):
     non-aggregate result larger than fallback_scan_row_cap refuses with a
     clear error instead of exhausting host RAM."""
     time_col = entry.time_column
+    if stmt.grouping_sets is not None:
+        raise FallbackError(
+            "GROUPING SETS/ROLLUP/CUBE over a chunked-scale table is not "
+            "supported yet; aggregate per set explicitly or reduce the "
+            "table")
     if any(j.kind in ("right", "full") for j in stmt.joins):
         # per-chunk outer joins would re-emit every unmatched right row
         # once per chunk; correct chunked outer joins need global match
@@ -1706,6 +1761,7 @@ def _eval(e, df, time_col):
 
 _RANK_FNS = {"row_number", "rank", "dense_rank"}
 _WINDOW_AGGS = {"sum", "min", "max", "count", "avg"}
+_SHIFT_FNS = {"lag", "lead"}
 
 
 def _eval_window(e: WindowCall, df, time_col) -> pd.Series:
@@ -1714,7 +1770,7 @@ def _eval_window(e: WindowCall, df, time_col) -> pd.Series:
     partition without it and as running (cumulative) aggregates with it
     (the standard's default RANGE UNBOUNDED PRECEDING frame, approximated
     row-wise)."""
-    if e.name not in _RANK_FNS | _WINDOW_AGGS:
+    if e.name not in _RANK_FNS | _WINDOW_AGGS | _SHIFT_FNS:
         raise FallbackError(f"unsupported window function {e.name!r}")
 
     # NULL partition keys form their own partition: string keys fill
@@ -1750,6 +1806,38 @@ def _eval_window(e: WindowCall, df, time_col) -> pd.Series:
         if e.name == "rank":
             return min_rn.astype(np.int64)
         return by(min_rn).rank(method="dense").astype(np.int64)
+
+    if e.name in ("lag", "lead"):
+        if not e.order_by:
+            raise FallbackError(f"{e.name}() requires ORDER BY")
+        v = _eval(e.args[0], df, time_col)
+
+        def const_arg(i, what):
+            if len(e.args) <= i:
+                return None
+            from tpu_olap.planner.exprutil import simplify
+            a = simplify(e.args[i])
+            if not isinstance(a, Lit):
+                raise FallbackError(
+                    f"{e.name}() {what} must be a constant")
+            return a.value
+
+        off = const_arg(1, "offset")
+        off = 1 if off is None else int(off)  # 0 is a valid offset
+        default = const_arg(2, "default")
+        order = work.sort_values(order_cols, ascending=ascending,
+                                 kind="stable", key=_null_low_key).index
+        vo = v.reindex(order)
+        gk = [k.reindex(order) for k in grouped_keys]
+        shift = off if e.name == "lag" else -off
+        shifted = vo.groupby(gk, dropna=False).shift(shift)
+        if default is not None:
+            # the default applies only BEYOND the partition boundary,
+            # not to genuine NULL data values that were shifted in
+            marker = pd.Series(1, index=vo.index) \
+                .groupby(gk, dropna=False).shift(shift)
+            shifted = shifted.mask(marker.isna(), default)
+        return shifted.reindex(df.index)
 
     v = _eval_agg_input(e.args[0], df, time_col) if e.args else \
         pd.Series(1, index=df.index)

@@ -196,6 +196,9 @@ class _Rewriter:
             raise RewriteError(f"table {self.entry.name!r} is not "
                                "druid-backed (no segment index)")
         stmt = self.stmt
+        if stmt.grouping_sets is not None:
+            raise RewriteError(
+                "GROUPING SETS/ROLLUP/CUBE execute on the fallback path")
         conjuncts = _split_and(stmt.where)
         conjuncts = self._collapse_joins(conjuncts)
         conjuncts = [self._resolve(e) for e in conjuncts]

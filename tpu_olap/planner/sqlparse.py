@@ -131,6 +131,10 @@ class SelectStmt:
     derived: object = None
     # FROM <table> [AS] alias — hides the base name in this scope
     table_alias: str | None = None
+    # GROUP BY ROLLUP/CUBE/GROUPING SETS: list of group-expr lists
+    # (None = plain GROUP BY). group_by still holds the full detail
+    # list; the device rewriter declines, the fallback unions the sets.
+    grouping_sets: list | None = None
 
 
 @dataclass
@@ -335,10 +339,23 @@ class _Parser:
         if self.at_kw("group"):
             self.take()
             self.take_kw("by")
-            stmt.group_by.append(self.expr())
-            while self.peek() == ("op", ","):
-                self.take()
+            w = self.peek()
+            nxt = self.toks[self.i + 1] if self.i + 1 < len(self.toks) \
+                else ("eof", None)
+            # only the construct spellings: a plain column named
+            # rollup/cube/grouping must still GROUP BY normally
+            is_construct = w[0] == "name" and (
+                (w[1].lower() in ("rollup", "cube")
+                 and nxt == ("op", "("))
+                or (w[1].lower() == "grouping" and nxt[0] == "name"
+                    and str(nxt[1]).lower() == "sets"))
+            if is_construct:
+                self._grouping_sets(stmt)
+            else:
                 stmt.group_by.append(self.expr())
+                while self.peek() == ("op", ","):
+                    self.take()
+                    stmt.group_by.append(self.expr())
         if self.at_kw("having"):
             self.take()
             stmt.having = self.expr()
@@ -357,6 +374,9 @@ class _Parser:
         # 1-based projection ordinal, never a constant (sorting by a
         # constant would silently return unordered results)
         stmt.group_by = [_resolve_ordinal(e, stmt) for e in stmt.group_by]
+        if stmt.grouping_sets is not None:
+            stmt.grouping_sets = [[_resolve_ordinal(e, stmt) for e in s]
+                                  for s in stmt.grouping_sets]
         for oi in stmt.order_by:
             oi.expr = _resolve_ordinal(oi.expr, stmt)
         # end-of-input is checked by statement(): a select may also end
@@ -602,6 +622,60 @@ class _Parser:
             order = [(e, d) for e, d, _ in items]
         self.take("op", ")")
         return WindowCall(fname, args, tuple(partition), tuple(order))
+
+    def _grouping_sets(self, stmt):
+        """GROUP BY ROLLUP(a, b) | CUBE(a, b) | GROUPING SETS((a,b),(a),())
+        -> stmt.grouping_sets = [[expr, ...], ...] (fallback-only; the
+        rewriter declines). stmt.group_by holds the full detail list so
+        projections/ordinals resolve normally."""
+        word = self.take("name").lower()
+        if word == "grouping":
+            nxt = self.take("name")
+            if nxt.lower() != "sets":
+                raise SqlError(f"expected SETS after GROUPING, got {nxt!r}")
+            self.take("op", "(")
+            sets = []
+            while True:
+                if self.peek() == ("op", "("):
+                    self.take()
+                    s = []
+                    if self.peek() != ("op", ")"):
+                        s.append(self.expr())
+                        while self.peek() == ("op", ","):
+                            self.take()
+                            s.append(self.expr())
+                    self.take("op", ")")
+                else:
+                    s = [self.expr()]
+                sets.append(s)
+                if self.peek() == ("op", ","):
+                    self.take()
+                    continue
+                break
+            self.take("op", ")")
+        else:
+            self.take("op", "(")
+            exprs = [self.expr()]
+            while self.peek() == ("op", ","):
+                self.take()
+                exprs.append(self.expr())
+            self.take("op", ")")
+            if word == "rollup":
+                sets = [exprs[:i] for i in range(len(exprs), -1, -1)]
+            else:  # cube: every subset, detail-first
+                from itertools import combinations
+                sets = [list(c) for r in range(len(exprs), -1, -1)
+                        for c in combinations(exprs, r)]
+        # the full detail list: first-seen order over all sets
+        seen, full = set(), []
+        for s in sets:
+            for e in s:
+                k = repr(e)
+                if k not in seen:
+                    seen.add(k)
+                    full.append(e)
+        stmt.group_by = full
+        stmt.grouping_sets = sets
 
     def _order_items(self) -> list:
         """Comma list of `expr [ASC|DESC] [NULLS FIRST|LAST]` ->
