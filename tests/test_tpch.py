@@ -254,6 +254,23 @@ def test_q2_correlated_minimum(eng):
     assert int(got["n"][0]) == int((df.l_extendedprice == mn).sum())
 
 
+def test_q20_nested_in_with_inner_correlation(eng):
+    """Q20 shape: an IN subquery whose body itself contains an
+    equality-correlated scalar aggregate — the middle scope is the
+    correlation target, resolved recursively."""
+    df = _olps()
+    got = eng.sql(
+        "SELECT count(*) AS n FROM olps WHERE p_brand IN "
+        "(SELECT o2.p_brand FROM olps o2 WHERE o2.l_quantity > "
+        " (SELECT 0.5 * avg(o3.l_quantity) FROM olps o3 "
+        "  WHERE o3.p_brand = o2.p_brand))")
+    avg = df.groupby("p_brand")["l_quantity"].mean()
+    brands = set(df.loc[df["l_quantity"]
+                        > 0.5 * df["p_brand"].map(avg), "p_brand"])
+    exp = int(df["p_brand"].isin(brands).sum())
+    assert int(got["n"][0]) == exp
+
+
 def test_monthly_timeseries(eng):
     """Granularity bucketing over the order date (the reference's
     date-function suites)."""
