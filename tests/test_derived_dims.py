@@ -136,6 +136,44 @@ def test_pallas_auto_flop_budget_gates_large_k():
     assert "FLOP" in gated.pallas_reason
 
 
+def test_pallas_tuning_file_supplies_auto_default(tmp_path, monkeypatch):
+    """With EngineConfig.pallas_auto_flop_budget unset, the 'auto'
+    policy reads the hardware-fitted default from
+    planner/pallas_tuning.json (written by tools/fit_pallas_budget.py
+    from the on-chip A/B)."""
+    import json
+    import os
+    import tpu_olap.executor.lowering as L
+    from tpu_olap.executor.lowering import lower
+    path = os.path.join(os.path.dirname(L.__file__), "..", "planner",
+                        "pallas_tuning.json")
+    df = _table()
+    q = "SELECT city, sum(v) AS s FROM t GROUP BY city"
+
+    def plan_with_tuning(budget):
+        L._tuning_cache = None  # drop the memo so the file is re-read
+        e = Engine(EngineConfig(use_pallas="auto"))
+        e.register_table("t", df, time_column="ts")
+        p = e.planner.plan(q)
+        orig = L._default_backend
+        L._default_backend = lambda: "tpu"
+        try:
+            return lower(p.query, p.entry.segments, e.config)
+        finally:
+            L._default_backend = orig
+            L._tuning_cache = None
+
+    assert not os.path.exists(path)  # never committed; test-scoped only
+    try:
+        with open(path, "w") as f:
+            json.dump({"auto_flop_budget": 1.0}, f)
+        gated = plan_with_tuning(1.0)
+        assert gated.pallas_reason is not None
+        assert "FLOP" in gated.pallas_reason
+    finally:
+        os.remove(path)
+
+
 def test_derived_stream_under_mesh_parity():
     df = _table()
     plain = Engine()

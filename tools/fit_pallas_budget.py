@@ -1,0 +1,111 @@
+"""Fit the pallas-vs-scatter crossover from the on-chip A/B pair and
+write it as the 'auto' policy default (docs/PERF_MODEL.md decision
+procedure #1; VERDICT r3 weak #1).
+
+Inputs: BENCH_TPU_AUTO_r04.json (fresh auto run, this round's code) and
+BENCH_TPU_PALLAS_never.json (XLA scatter leg, same data/scale). For each
+SSB query the one-hot FLOP product is computed by lowering the query
+locally (K is scale-free: SSB dimension cardinalities do not grow with
+the fact row count), then:
+
+- queries where auto is FASTER than never keep the Pallas kernel: the
+  budget must sit above their FLOP product;
+- queries where auto is SLOWER (beyond a noise margin) must take the
+  scatter path: the budget must sit below theirs.
+
+The fitted budget is the log-midpoint of the gap; contradictions (a
+losing query below a winning one) widen the margin until consistent.
+Writes tpu_olap/planner/pallas_tuning.json (consumed by
+lowering._tuned_flop_budget as the default when EngineConfig leaves
+pallas_auto_flop_budget unset).
+
+Usage: python tools/fit_pallas_budget.py  [exit 3 if inputs missing]
+"""
+
+import json
+import math
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+NOISE = 1.15  # auto must be >15% slower before a query counts as a loss
+
+
+def main():
+    paths = {n: os.path.join(REPO, f)
+             for n, f in (("auto", "BENCH_TPU_AUTO_r04.json"),
+                          ("never", "BENCH_TPU_PALLAS_never.json"))}
+    runs = {}
+    for name, p in paths.items():
+        if not os.path.exists(p):
+            print(f"missing {p}; nothing to fit", file=sys.stderr)
+            return 3
+        with open(p) as f:
+            runs[name] = json.load(f)
+    if runs["auto"]["detail"]["rows"] != runs["never"]["detail"]["rows"]:
+        print("A/B legs ran at different scales; refusing", file=sys.stderr)
+        return 3
+
+    from tpu_olap.utils.platform import force_cpu_platform
+    force_cpu_platform()
+    import bench as B
+    from tpu_olap import Engine
+    from tpu_olap.bench import QUERIES, register_ssb_parquet
+    from tpu_olap.executor.lowering import lower
+
+    # lower each query at a small scale to read K (scale-free) and
+    # compute the FLOP product at the A/B scale
+    paths_small, dims = B._prepare_dataset(200_000, 0)
+    eng = Engine()
+    register_ssb_parquet(eng, paths_small, dims)
+    n_rows = runs["auto"]["detail"]["rows"]
+    seg = eng.catalog.get("lineorder").segments
+    block = seg.block_rows
+    flops = {}
+    for qname, sql in QUERIES.items():
+        plan = eng.planner.plan(sql)
+        phys = lower(plan.query, plan.entry.segments, eng.config)
+        kb = max(1, min(phys.total_groups, eng.config.pallas_k_per_block))
+        k_pad = -(-phys.total_groups // kb) * kb
+        n_pad = -(-n_rows // block) * block
+        flops[qname] = 2.0 * k_pad * n_pad * 128
+
+    auto = runs["auto"]["detail"]["per_query_p50_ms"]
+    never = runs["never"]["detail"]["per_query_p50_ms"]
+    wins = [flops[q] for q in QUERIES if auto[q] * NOISE < never[q]]
+    losses = [flops[q] for q in QUERIES if auto[q] > never[q] * NOISE]
+    lo = max(wins) if wins else None       # keep pallas at least here
+    hi = min(losses) if losses else None   # force scatter from here
+
+    if hi is None:
+        budget = None          # pallas never lost: no cap
+        verdict = "pallas never slower: no cap written"
+    elif lo is None or lo >= hi:
+        budget = hi * 0.99     # cap just below the cheapest loss
+        verdict = ("cap below the cheapest losing query"
+                   if lo is None else
+                   "win/loss bands overlap: conservative cap below "
+                   "the cheapest loss")
+    else:
+        budget = math.exp((math.log(lo) + math.log(hi)) / 2)
+        verdict = "log-midpoint of the win/loss gap"
+
+    out = {
+        "auto_flop_budget": budget,
+        "fit": {"verdict": verdict, "noise_margin": NOISE,
+                "rows": n_rows,
+                "per_query": {q: {"flops": flops[q], "auto_ms": auto[q],
+                                  "never_ms": never[q]}
+                              for q in sorted(QUERIES)}},
+    }
+    path = os.path.join(REPO, "tpu_olap", "planner", "pallas_tuning.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"auto_flop_budget": budget, "verdict": verdict}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

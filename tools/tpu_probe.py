@@ -153,8 +153,16 @@ def _pallas_validation_done():
 
 
 EXTRA_LEGS = [
+    # fresh auto run with THIS round's code (derived streams resident):
+    # the A/B pair must not straddle the round-3/round-4 code boundary
+    ("auto bench r04", _file_done("BENCH_TPU_AUTO_r04.json"),
+     _bench_leg("BENCH_TPU_AUTO_r04.json")),
     ("pallas-never bench", _file_done("BENCH_TPU_PALLAS_never.json"),
      _bench_leg("BENCH_TPU_PALLAS_never.json", use_pallas="never")),
+    ("fit pallas budget",
+     _file_done(os.path.join("tpu_olap", "planner",
+                             "pallas_tuning.json")),
+     lambda: attempt_cmd(["tools/fit_pallas_budget.py"], timeout=600)),
     ("per-query profile", _file_done("PROFILE_TPU.json"),
      lambda: attempt_cmd(["tools/profile_tpu.py"])),
     ("pallas hw validation", _pallas_validation_done,

@@ -426,8 +426,10 @@ def _maybe_use_pallas(plan, query, table, config, filter_fn, imask_fn=None):
     if reason is not None:
         plan.pallas_reason = reason
         return
-    if config.use_pallas == "auto" and \
-            config.pallas_auto_flop_budget is not None:
+    budget = config.pallas_auto_flop_budget
+    if budget is None:
+        budget = _tuned_flop_budget()
+    if config.use_pallas == "auto" and budget is not None:
         # the one-hot reduce is O(K·n): K_pad*n*H_pad*2 FLOPs
         # (docs/PERF_MODEL.md). Past the budget the XLA scatter kernel
         # wins — its work is n-bound and K-free.
@@ -435,10 +437,10 @@ def _maybe_use_pallas(plan, query, table, config, filter_fn, imask_fn=None):
         kb = max(1, min(plan.total_groups, config.pallas_k_per_block))
         k_pad = -(-plan.total_groups // kb) * kb
         flops = 2.0 * k_pad * n * 128
-        if flops > config.pallas_auto_flop_budget:
+        if flops > budget:
             plan.pallas_reason = (
                 f"auto: one-hot reduce needs {flops:.2e} FLOPs for "
-                f"K={plan.total_groups}; over pallas_auto_flop_budget")
+                f"K={plan.total_groups}; over the auto flop budget")
             return
     plan.kernel = pallas_reduce.build_kernel(plan, table, config, filter_fn,
                                              interpret=not on_tpu,
@@ -450,6 +452,32 @@ def _maybe_use_pallas(plan, query, table, config, filter_fn, imask_fn=None):
 def _default_backend() -> str:
     import jax
     return jax.default_backend()
+
+
+_tuning_cache: dict | None = None
+
+
+def _tuned_flop_budget():
+    """Hardware-fitted default for the pallas-vs-scatter crossover:
+    tools/fit_pallas_budget.py writes planner/pallas_tuning.json from
+    the on-chip A/B pair (docs/PERF_MODEL.md decision procedure #1).
+    An explicit EngineConfig.pallas_auto_flop_budget overrides it;
+    absent file = no cap (pre-A/B behavior)."""
+    global _tuning_cache
+    if _tuning_cache is None:
+        import json
+        import os
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "planner", "pallas_tuning.json")
+        data = {}
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+            except Exception:  # noqa: BLE001 — a bad file must not
+                data = {}      # break query planning
+        _tuning_cache = data
+    return _tuning_cache.get("auto_flop_budget")
 
 
 def _lower_mask(query, table, config) -> PhysicalPlan:
