@@ -133,13 +133,29 @@ def test_q5_local_supplier_volume_star(eng):
         GROUP BY s_nation ORDER BY revenue DESC""", True)
 
 
-def test_q5_row_comparison_falls_back(eng):
-    """True Q5 requires c_nation = s_nation (row-vs-row), outside the
-    dimension/filter algebra — must still answer via the fallback."""
+def test_q5_row_comparison_on_device(eng):
+    """True Q5 requires c_nation = s_nation (row-vs-row) — served on the
+    device path via the columnComparison filter's cross-dictionary code
+    translation (round 4; previously a structural fallback)."""
     _check(eng, """
         SELECT s_nation, sum(l_extendedprice) AS revenue
         FROM olps WHERE c_nation = s_nation
-        GROUP BY s_nation ORDER BY s_nation""", False)
+        GROUP BY s_nation ORDER BY s_nation""", True)
+
+
+def test_q7_cross_nation_volume(eng):
+    """Q7 shape: shipping volume between distinct nations — the <>
+    row-vs-row comparison composes as NOT(columnComparison), plus the
+    classic literal nation-pair disjunction."""
+    _check(eng, """
+        SELECT s_nation, c_nation, sum(l_extendedprice) AS volume
+        FROM olps
+        WHERE c_nation <> s_nation AND s_region = 'EUROPE'
+        GROUP BY s_nation, c_nation ORDER BY volume DESC LIMIT 8""", True)
+    _check(eng, """
+        SELECT sum(l_extendedprice) AS volume FROM olps
+        WHERE (s_nation = 'FRANCE' AND c_nation = 'GERMANY')
+           OR (s_nation = 'GERMANY' AND c_nation = 'FRANCE')""", True)
 
 
 def test_q6_forecast_revenue(eng):

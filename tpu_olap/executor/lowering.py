@@ -50,6 +50,10 @@ class PhysicalPlan:
     columns: tuple = ()        # physical columns the kernel reads
     null_cols: tuple = ()
     virtual_exprs: dict = field(default_factory=dict)
+    # (token, source_col, const_name) derived streams the compiled
+    # filters need (columnComparison code translation); the runner
+    # materializes each once per content token (see dataset.derived)
+    filter_streams: tuple = ()
     pallas_reason: str | None = "not attempted"  # None = pallas kernel active
     sparse: bool = False       # sort-based path for huge group spaces
     make_sparse_kernel: object = None   # cap -> kernel fn (sparse only)
@@ -396,6 +400,7 @@ def _lower_agg(query, table, config) -> PhysicalPlan:
         agg_plans=agg_plans, sizes=sizes, total_groups=total,
         pruned_ids=pruned, t_min=t_min, t_max=t_max, empty=empty,
         columns=columns, null_cols=null_cols, virtual_exprs=vexprs,
+        filter_streams=_dedupe_streams(pool),
         sparse=sparse, make_sparse_kernel=make_sparse_kernel if sparse
         else None)
     if not sparse:
@@ -523,7 +528,18 @@ def _lower_mask(query, table, config) -> PhysicalPlan:
         query=query, table=table, kind="mask", pool=pool, kernel=kernel,
         statics=statics, pruned_ids=pruned, t_min=t_min, t_max=t_max,
         empty=empty, columns=tuple(sorted(phys)), null_cols=null_cols,
-        virtual_exprs=vexprs)
+        virtual_exprs=vexprs, filter_streams=_dedupe_streams(pool))
+
+
+def _dedupe_streams(pool: ConstPool) -> tuple:
+    """Unique filter-derived stream requests in first-seen order (the
+    same column pair can appear in several conjuncts of one query)."""
+    seen, out = set(), []
+    for token, src, cname in pool.streams:
+        if token not in seen:
+            seen.add(token)
+            out.append((token, src, cname))
+    return tuple(out)
 
 
 def _jnp():

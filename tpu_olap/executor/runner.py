@@ -347,7 +347,8 @@ class QueryRunner:
         bp_token = bp.cache_token if bp is not None else None
         tokens = [dp.cache_token for dp in plan.dim_plans
                   if dp.cache_token is not None] \
-            + ([bp_token] if bp_token else [])
+            + ([bp_token] if bp_token else []) \
+            + [t for t, _, _ in plan.filter_streams]
         if tokens:
             # pin this query's whole working set (columns + every derived
             # stream it needs) so one derived add cannot evict another
@@ -365,6 +366,12 @@ class QueryRunner:
                 env["cols"][bp.derived_name] = ds.derived(
                     bp_token,
                     lambda: self._build_bucket_stream(ds, plan), pinned)
+            for token, src, cname in plan.filter_streams:
+                env["cols"]["\0d:" + token] = ds.derived(
+                    token,
+                    lambda src=src, cname=cname:
+                        self._build_filter_stream(ds, plan, src, cname),
+                    pinned)
         valid = ds.valid()
         seg_mask = ds.segment_mask(plan.pruned_ids if not plan.empty else [])
         metrics["segments_total"] = len(table.segments)
@@ -402,6 +409,20 @@ class QueryRunner:
             return dp.ids(env2, cdev, jnp).astype(jnp.int32)
 
         return jax.jit(f)(col)
+
+    def _build_filter_stream(self, ds, plan: PhysicalPlan, src, cname):
+        """Materialize a filter-owned derived id stream [S, R] int32:
+        the columnComparison cross-dictionary translation gather, paid
+        once per (table, column pair), not per dispatch (a 1-D gather
+        over every row is ~60 ms on a v5e through XLA)."""
+        col = ds.col(src)
+        xmap = plan.pool.consts[cname]
+        if self.config.platform == "cpu":
+            return np.asarray(xmap)[np.asarray(col)].astype(np.int32)
+        import jax
+        import jax.numpy as jnp
+        return jax.jit(
+            lambda c: jnp.asarray(xmap)[c].astype(jnp.int32))(col)
 
     def _build_bucket_stream(self, ds, plan: PhysicalPlan):
         """Calendar-granularity bucket ids [S, R] int32: the searchsorted

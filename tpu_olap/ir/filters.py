@@ -201,6 +201,39 @@ class NotFilter(FilterSpec):
         return NotFilter(from_json("filter", d["field"]))
 
 
+@register("filter", "columnComparison")
+@dataclass(frozen=True)
+class ColumnComparisonFilter(FilterSpec):
+    """Row-vs-row equality across two (or more, chained pairwise) columns
+    — the reference family's columnComparison filter (SURVEY.md §3.3),
+    the shape TPC-H Q5/Q7 need (`c_nation = s_nation` on the denormalized
+    fact). Divergence from Druid, by design: a NULL operand never matches
+    (engine-wide boolean rule, see kernels.filtereval module docstring;
+    Druid treats two missing values as equal). SQL `a <> b` composes as
+    NotFilter(ColumnComparisonFilter), under which NULL rows match — the
+    same inversion semantics every other NOT shape has here.
+
+    String/string pairs compare via a cross-dictionary code translation
+    map built host-side and hoisted to a device-resident derived stream
+    (executor/dataset.py::derived), so the device cost is one elementwise
+    int32 compare, not a per-dispatch gather."""
+    dimensions: tuple  # >= 2 column names
+
+    def columns(self):
+        return set(self.dimensions)
+
+    def to_json(self):
+        return {"type": "columnComparison",
+                "dimensions": list(self.dimensions)}
+
+    @staticmethod
+    def from_json(d):
+        dims = tuple(d["dimensions"])
+        if len(dims) < 2:
+            raise ValueError("columnComparison needs >= 2 dimensions")
+        return ColumnComparisonFilter(dims)
+
+
 @register("filter", "expression")
 @dataclass(frozen=True)
 class ExpressionFilter(FilterSpec):

@@ -39,6 +39,11 @@ class ConstPool:
     def __init__(self):
         self.consts: dict[str, np.ndarray] = {}
         self.tags: list[str] = []
+        # (token, source_col, const_name) derived-stream requests from
+        # filter compilation (columnComparison code translation): the
+        # runner materializes consts[const_name][codes(source_col)] once
+        # per content token as a device-resident "\0d:<token>" env column
+        self.streams: list[tuple[str, str, str]] = []
         self._n = 0
 
     def add(self, value, dtype=None) -> str:
@@ -108,6 +113,13 @@ def compile_filter(spec, table, pool: ConstPool, virtual_exprs=None):
         if isinstance(s, F.NotFilter):
             fn = lower(s.field)
             return lambda env, c: ~fn(env, c)
+        if isinstance(s, F.ColumnComparisonFilter):
+            if len(s.dimensions) < 2:
+                raise UnsupportedFilter(
+                    "columnComparison needs >= 2 dimensions")
+            pairs = [_colcmp_pair(a, b)
+                     for a, b in zip(s.dimensions, s.dimensions[1:])]
+            return lambda env, c: _fold_direct(pairs, env, c)
         if isinstance(s, F.ExpressionFilter):
             expr = s.expression
             phys = set()
@@ -270,6 +282,54 @@ def compile_filter(spec, table, pool: ConstPool, virtual_exprs=None):
             return m
         return fn
 
+    colcmp_cache: dict = {}
+
+    def _colcmp_pair(a, b):
+        """One (a, b) equality leg of a columnComparison filter. NULL
+        operands never match (module-docstring boolean rule; NotFilter
+        inversion gives the null-matches semantics SQL `<>` needs).
+        Memoized per pair: the same comparison in several conjuncts must
+        not ship duplicate dictionary-sized consts."""
+        hit = colcmp_cache.get((a, b))
+        if hit is not None:
+            return hit
+        ta, tb = col_type(a), col_type(b)
+        a_str = ta is ColumnType.STRING
+        b_str = tb is ColumnType.STRING
+        if a_str != b_str:
+            raise UnsupportedFilter(
+                f"columnComparison across string and numeric columns "
+                f"({a!r}, {b!r})")
+        if not a_str:
+            # numeric (incl. __time / virtual): elementwise compare;
+            # int-vs-float promotes. Virtuals are materialized into the
+            # env (with their null masks) before any filter fn runs.
+            fn = lambda env, c: ((env["cols"][a] == env["cols"][b])  # noqa: E731
+                                 & ~_null_mask(env, a)
+                                 & ~_null_mask(env, b))
+            colcmp_cache[(a, b)] = fn
+            return fn
+        # string/string: translate a's codes into b's dictionary space.
+        # xmap[0] = -1 (null never matches); values absent from b's
+        # dictionary map to -1 (id_of). b's codes are 0 (null) or >= 1,
+        # so `xmap[code_a] == code_b` alone is the non-null equality.
+        da, db = table.dictionaries[a], table.dictionaries[b]
+        xmap = np.full(da.size + 1, -1, np.int32)
+        for i, v in enumerate(da.values):
+            xmap[i + 1] = db.id_of(v)
+        cname = pool.add(xmap, np.int32)
+        token = _stream_token("cc", a, b, xmap)
+        pool.streams.append((token, a, cname))
+        pool.tag(f"cc:{token}")  # closure structure depends on the stream
+        dname = "\0d:" + token
+
+        def fn(env, c):
+            hit = env["cols"].get(dname)
+            ta_ids = hit if hit is not None else c[cname][env["cols"][a]]
+            return ta_ids == env["cols"][b]
+        colcmp_cache[(a, b)] = fn
+        return fn
+
     def _table_filter(col, typ, make_table):
         if typ is not ColumnType.STRING:
             raise UnsupportedFilter(
@@ -282,6 +342,18 @@ def compile_filter(spec, table, pool: ConstPool, virtual_exprs=None):
 
 
 # ---------------------------------------------------------------------------
+
+
+def _stream_token(*parts) -> str:
+    """Content hash over everything a filter-derived id stream depends on
+    (mirrors executor.dimplan._dim_token)."""
+    import hashlib
+    h = hashlib.sha1()
+    for p in parts:
+        h.update(p.tobytes() if isinstance(p, np.ndarray)
+                 else repr(p).encode())
+        h.update(b"\x1f")
+    return h.hexdigest()[:16]
 
 
 def _parse_num(value, typ):

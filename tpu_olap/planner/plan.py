@@ -532,6 +532,34 @@ class _Rewriter:
                     return F.BoundFilter(
                         col, lower=v, lower_strict=(op == ">"),
                         extraction_fn=fn)
+            if isinstance(left, Col) and isinstance(right, Col):
+                ca = self._check_col(left.name)
+                cb = self._check_col(right.name)
+                sa = self._col_type(ca) is ColumnType.STRING
+                sb = self._col_type(cb) is ColumnType.STRING
+                if sa != sb:
+                    raise RewriteError(
+                        f"comparison between string and numeric columns "
+                        f"({ca!r}, {cb!r})")
+                if sa:
+                    # row-vs-row string equality: the columnComparison
+                    # filter (TPC-H Q5/Q7 `c_nation = s_nation`); <>
+                    # composes as NOT, under which NULL rows match —
+                    # same as the fallback's pandas semantics
+                    if op == "==":
+                        return F.ColumnComparisonFilter((ca, cb))
+                    if op == "!=":
+                        return F.NotFilter(
+                            F.ColumnComparisonFilter((ca, cb)))
+                    raise RewriteError(
+                        "ordered comparison between string columns")
+            if op == "!=":
+                # general `a <> b` must lower as NOT(a = b): a bare
+                # ExpressionFilter(!=) would exclude NULL operands
+                # (boolean leaf rule) while the fallback's pandas
+                # `NaN != x` is True — NOT(==) matches the fallback
+                inner = self._to_filter(BinOp("==", left, right))
+                return F.NotFilter(inner)
             if isinstance(left, Col) and isinstance(right, Lit):
                 col = self._check_col(left.name)
                 v = right.value
