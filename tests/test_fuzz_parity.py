@@ -33,6 +33,14 @@ def _make_table(rng, n):
         "qty": rng.integers(-50, 200, n).astype(np.int64),
         "price": np.round(rng.random(n) * 1000, 3),
     })
+    # a second city-vocabulary column for columnComparison shapes,
+    # derived WITHOUT consuming rng draws (keeps every other column's
+    # per-seed values stable across grammar generations); the roll
+    # guarantees frequent matches and mismatches, the shift skews the
+    # vocabulary so cross-dictionary translation sees absent values
+    frame["peer"] = np.where(
+        frame["small"] >= 3, np.roll(cities, 7),
+        np.array([f"city{(int(c[4:]) + 2) % 11}" for c in cities], object))
     if rng.random() < 0.5:
         frame.loc[rng.random(n) < 0.04, "qty"] = np.nan
         frame["qty"] = frame["qty"].astype("Int64")
@@ -55,7 +63,7 @@ def _star():
             column_map={"d_city": "城市", "d_region": "region"}),))
 
 
-_DIMS = ["cat", "城市", "small", "region"]
+_DIMS = ["cat", "城市", "small", "region", "peer"]
 _AGGS = [
     ("sum(qty)", "sq"), ("sum(price)", "sp"), ("count(*)", "n"),
     ("min(price)", "mp"), ("max(qty)", "xq"), ("avg(price)", "ap"),
@@ -97,6 +105,15 @@ _FILTERS = [
     "substr(城市, 5, 1) IN ('1', '3', '8')",
     "substr(城市, 5, 1) >= '2' AND substr(城市, 5, 1) < '6'",
     "lower(region) = 'west'",
+    # columnComparison shapes (round 4): row-vs-row equality across
+    # string dims (cross-dictionary translation incl. absent values)
+    # and numeric columns, plus the NOT composition where NULLs match
+    "城市 = peer",
+    "城市 <> peer",
+    "城市 = peer AND qty > 25",
+    "NOT (城市 = peer) OR cat = 'alpha'",
+    "small = qty",
+    "small <> qty",
 ]
 _TIME_EXPRS = [None, "year(ts)", "month(ts)", "quarter(ts)",
                "date_trunc('day', ts)"]

@@ -33,6 +33,26 @@ def eng():
     return e, fact, dim
 
 
+def test_subquery_inlining_runs_inner_on_device(eng):
+    """Uncorrelated subquery inlining (round 4): the inner aggregate
+    executes through the engine — on the DEVICE path for an accelerated
+    table — and the outer query pushes down with the result inlined
+    (the reference's split: Spark ran the subquery, the rewritten outer
+    query hit Druid; SURVEY.md §3.1)."""
+    e, fact, dim = eng
+    n0 = len(e.history)
+    got = e.sql("SELECT grp, sum(v) AS s FROM fact "
+                "WHERE v > (SELECT avg(v) FROM fact) "
+                "GROUP BY grp ORDER BY grp")
+    assert e.last_plan.rewritten
+    # two device dispatches: the inner avg and the outer groupBy
+    assert len(e.history) == n0 + 2
+    mean = fact.v.sum() / len(fact)
+    expect = fact[fact.v > mean].groupby("grp").v.sum().sort_index()
+    assert list(got["grp"]) == list(expect.index)
+    assert [int(x) for x in got["s"]] == [int(x) for x in expect.values]
+
+
 def test_right_join(eng):
     e, fact, dim = eng
     got = e.sql("""SELECT dim.dname AS dname, count(fact.v) AS n

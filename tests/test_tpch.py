@@ -228,7 +228,9 @@ def test_q10_returned_revenue(eng):
 
 def test_q11_having_scalar_subquery(eng):
     """Q11 shape: HAVING against a scalar aggregate subquery (value
-    fraction threshold) — fallback path, independent pandas oracle."""
+    fraction threshold). Round 4: the uncorrelated subquery executes
+    eagerly and inlines, so BOTH halves ride the device path —
+    independent pandas oracle."""
     df = _olps()
     got = eng.sql("""
         SELECT p_brand, sum(l_extendedprice) AS val
@@ -236,7 +238,7 @@ def test_q11_having_scalar_subquery(eng):
         HAVING sum(l_extendedprice) >
                (SELECT sum(l_extendedprice) * 0.024 FROM olps)
         ORDER BY val DESC""")
-    assert not eng.last_plan.rewritten
+    assert eng.last_plan.rewritten
     by_brand = df.groupby("p_brand").l_extendedprice.sum()
     oracle = by_brand[by_brand > df.l_extendedprice.sum() * 0.024] \
         .sort_values(ascending=False)
@@ -282,14 +284,16 @@ def test_q15_top_revenue_cte(eng):
 
 def test_q18_in_aggregating_subquery(eng):
     """Q18 shape: outer aggregate restricted by IN over a GROUP BY ...
-    HAVING subquery; fallback path, independent oracle."""
+    HAVING subquery. Round 4: the subquery runs eagerly (itself on the
+    device) and its values inline into an in filter, so the outer
+    aggregate pushes down too — independent oracle."""
     df = _olps()
     got = eng.sql("""
         SELECT p_brand, sum(l_quantity) AS q FROM olps
         WHERE p_brand IN (SELECT p_brand FROM olps GROUP BY p_brand
                           HAVING sum(l_quantity) > 7000)
         GROUP BY p_brand ORDER BY q DESC""")
-    assert not eng.last_plan.rewritten
+    assert eng.last_plan.rewritten
     qty = df.groupby("p_brand").l_quantity.sum()
     oracle = qty[qty > 7000].sort_values(ascending=False)
     assert 0 < len(oracle) < len(qty)

@@ -263,14 +263,23 @@ def _uncorrelated(stmt) -> bool:
         return False
 
 
-def _resolve_subqueries(stmt: SelectStmt, catalog, config) -> SelectStmt:
+def _resolve_subqueries(stmt: SelectStmt, catalog, config,
+                        run=None) -> SelectStmt:
     """Replace Subquery nodes (scalar) and in_subquery calls (IN lists)
     with literals by executing the nested statements, and LOOKUP(col,
     'name') references with their registered map inlined (the evaluator
     has no catalog access). Equality-correlated subqueries (the TPC-H
     class: scalar aggregates, EXISTS, IN) decorrelate into precomputed
     key->value maps evaluated per outer row; any other correlation shape
-    keeps the legible rejection."""
+    keeps the legible rejection.
+
+    `run` executes one nested statement -> DataFrame. The default is the
+    pandas interpreter; the planner passes the engine's stmt executor so
+    inner aggregates ride the device path (the reference's split: Spark
+    ran the subquery, the rewritten outer query pushed to Druid —
+    SURVEY.md §3.1)."""
+    if run is None:
+        run = lambda s: execute_fallback(s, catalog, config)  # noqa: E731
     hit = False
     outer_tables = _scope_names(stmt) if isinstance(stmt, SelectStmt) \
         else set()
@@ -287,24 +296,23 @@ def _resolve_subqueries(stmt: SelectStmt, catalog, config) -> SelectStmt:
             s = e.args[0].stmt
             if not _uncorrelated(s):
                 return _decorrelate_exists(s, outer_tables, catalog,
-                                           config)
+                                           config, run)
             inner = _dc.replace(s, limit=1, order_by=[])
-            sub = execute_fallback(inner, catalog, config)
+            sub = run(inner)
             return Lit(len(sub) > 0)
         if isinstance(e, Subquery):
             hit = True
             if not _uncorrelated(e.stmt):
                 return _decorrelate_scalar(e.stmt, outer_tables, catalog,
-                                           config)
-            return Lit(_scalar_from(
-                execute_fallback(e.stmt, catalog, config)))
+                                           config, run)
+            return Lit(_scalar_from(run(e.stmt)))
         if isinstance(e, FuncCall) and e.name == "in_subquery":
             hit = True
             lhs = walk(e.args[0])
             if not _uncorrelated(e.args[1].stmt):
                 return _decorrelate_in(lhs, e.args[1].stmt, outer_tables,
-                                       catalog, config)
-            sub = execute_fallback(e.args[1].stmt, catalog, config)
+                                       catalog, config, run)
+            sub = run(e.args[1].stmt)
             if sub.shape[1] != 1:
                 raise FallbackError(
                     f"IN subquery returned {sub.shape[1]} columns")
@@ -461,7 +469,7 @@ def _corr_shape_guard(s, what):
             "decorrelated (rewrite as a join)")
 
 
-def _decorrelate_scalar(s, outer_tables, catalog, config):
+def _decorrelate_scalar(s, outer_tables, catalog, config, run):
     """(SELECT agg(...) FROM u WHERE u.k = t.k AND residual) -> a
     key->scalar map; outer rows with no matching key take the aggregate's
     empty-input value (NULL, or 0 for COUNT) computed by actually running
@@ -480,12 +488,12 @@ def _decorrelate_scalar(s, outer_tables, catalog, config):
         group_by=[ie for ie, _ in keys], where=_and_all(residual),
         order_by=[], limit=None, offset=0)
     try:
-        sub = execute_fallback(inner, catalog, config)
+        sub = run(inner)
         # empty-input probe: keep the pure-inner residual (comma joins
         # need their conditions) and conjoin a statically-false leaf
         empty = _dc.replace(s, where=_and_all(residual + [Lit(False)]),
                             order_by=[], limit=None, offset=0)
-        default = _scalar_from(execute_fallback(empty, catalog, config))
+        default = _scalar_from(run(empty))
     except FallbackError as err:
         # e.g. an UNQUALIFIED outer reference in the SELECT list resolves
         # as an unknown inner column — surface it as the correlation
@@ -504,7 +512,7 @@ def _decorrelate_scalar(s, outer_tables, catalog, config):
                     + tuple(oe for _, oe in keys))
 
 
-def _decorrelate_exists(s, outer_tables, catalog, config):
+def _decorrelate_exists(s, outer_tables, catalog, config, run):
     """EXISTS (SELECT ... FROM u WHERE u.k = t.k AND residual) -> a
     membership set over the correlation keys (semi-join)."""
     import dataclasses as _dc
@@ -519,7 +527,7 @@ def _decorrelate_exists(s, outer_tables, catalog, config):
         s, projections=[(ie, f"__ck{i}") for i, (ie, _) in enumerate(keys)],
         distinct=True, group_by=[], where=_and_all(residual),
         order_by=[], limit=None, offset=0)
-    sub = execute_fallback(inner, catalog, config)
+    sub = run(inner)
     kcols = [sub[f"__ck{j}"] for j in range(len(keys))]
     keyset = {kt for kt in _key_rows(kcols)
               if not any(k is None for k in kt)}
@@ -527,7 +535,7 @@ def _decorrelate_exists(s, outer_tables, catalog, config):
                     (Lit(tuple(keyset)),) + tuple(oe for _, oe in keys))
 
 
-def _decorrelate_in(lhs, s, outer_tables, catalog, config):
+def _decorrelate_in(lhs, s, outer_tables, catalog, config, run):
     """x IN (SELECT y FROM u WHERE u.k = t.k AND residual) -> membership
     over (key..., y) tuples; NULL x or NULL y never match (the engine's
     comparisons-with-NULL-are-False rule)."""
@@ -542,7 +550,7 @@ def _decorrelate_in(lhs, s, outer_tables, catalog, config):
                         for i, (ie, _) in enumerate(keys)] + [(ve, "__v")],
         distinct=True, group_by=[], where=_and_all(residual),
         order_by=[], limit=None, offset=0)
-    sub = execute_fallback(inner, catalog, config)
+    sub = run(inner)
     if len(sub) > config.fallback_scan_row_cap:
         raise FallbackError(
             "IN subquery result exceeds fallback_scan_row_cap")
@@ -1572,6 +1580,13 @@ def _eval(e, df, time_col):
             # subquery inlined as Lit(None)) matches no rows — pandas
             # would raise a TypeError on `series > None`
             return pd.Series(np.zeros(len(df), bool), index=df.index)
+        if e.op == "!=":
+            # a <> b IS NOT(a = b) engine-wide (the planner lowers it
+            # that way; NULL-operand rows match). Direct pandas `!=`
+            # would depend on the dtype representation: float-NaN
+            # comparisons yield True while nullable-dtype NA yields NA
+            # -> fillna(False) — opposite answers for the same data.
+            return ~_eval(BinOp("==", e.left, e.right), df, time_col)
         left = _eval(e.left, df, time_col)
         right = _eval(e.right, df, time_col)
         if e.op == "/":

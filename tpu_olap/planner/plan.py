@@ -127,6 +127,60 @@ def _outside_subset(stmt) -> str | None:
     return None
 
 
+def _collect_substmts(stmt) -> list:
+    """Every nested SELECT reachable from the statement's expressions
+    (scalar Subquery nodes, in_subquery / exists call arguments)."""
+    from tpu_olap.ir.expr import Subquery
+    out = []
+
+    def walk(e):
+        if isinstance(e, Subquery):
+            out.append(e.stmt)
+            return
+        if isinstance(e, BinOp):
+            walk(e.left)
+            walk(e.right)
+        elif isinstance(e, FuncCall):
+            for a in e.args:
+                walk(a)
+
+    for e in ([x for x, _ in stmt.projections] + stmt.group_by
+              + [stmt.where, stmt.having]
+              + [o.expr for o in stmt.order_by]
+              + [j.on for j in stmt.joins]):
+        if e is not None:
+            walk(e)
+    return out
+
+
+_FALLBACK_FUNCS = ("corr_scalar_map", "corr_exists_map", "corr_in_map")
+
+
+def _contains_fallback_nodes(stmt) -> bool:
+    """True when subquery resolution left decorrelated map nodes that
+    only the fallback evaluator can apply per outer row."""
+    found = False
+
+    def walk(e):
+        nonlocal found
+        if isinstance(e, FuncCall):
+            if e.name in _FALLBACK_FUNCS:
+                found = True
+            for a in e.args:
+                walk(a)
+        elif isinstance(e, BinOp):
+            walk(e.left)
+            walk(e.right)
+
+    for e in ([x for x, _ in stmt.projections] + stmt.group_by
+              + [stmt.where, stmt.having]
+              + [o.expr for o in stmt.order_by]
+              + [j.on for j in stmt.joins]):
+        if e is not None:
+            walk(e)
+    return found
+
+
 class DruidPlanner:
     """Registers no global state — one instance per Engine (the reference's
     DruidPlanner(sqlContext) kept per-session rule lists, SURVEY.md §3.2)."""
@@ -134,9 +188,15 @@ class DruidPlanner:
     def __init__(self, catalog, config):
         self.catalog = catalog
         self.config = config
+        # stmt -> DataFrame executor the Engine wires in: lets the
+        # planner evaluate uncorrelated subqueries eagerly (device path
+        # when rewritable) so the OUTER query can still push down
+        self.run_subquery = None
 
     def plan(self, sql: str) -> PlanResult:
-        stmt = parse_sql(sql)
+        return self.plan_stmt(parse_sql(sql), sql)
+
+    def plan_stmt(self, stmt, sql: str = "") -> PlanResult:
         # shapes outside the rewrite rules run on the fallback path (the
         # reference delegated them to full Spark SQL, SURVEY.md §3.1) —
         # declined here, never an error
@@ -158,6 +218,27 @@ class DruidPlanner:
                 fallback_reason="derived table (FROM subquery) executes "
                                 "on the fallback path")
         outside = _outside_subset(stmt)
+        if outside == "subquery" and self.run_subquery is not None:
+            # the reference's architecture for this shape: Spark executed
+            # the subquery, the rewritten outer query pushed to Druid
+            # (SURVEY.md §3.1). Inline uncorrelated subquery results as
+            # literals and try the device path for the outer query;
+            # anything that doesn't fully inline keeps the fallback.
+            alt = self._inline_uncorrelated(stmt)
+            if alt is not None:
+                entry = self.catalog.get(stmt.table)
+                # the inlined statement is the one to keep for ANY
+                # execution path: its subqueries already ran, so a
+                # fallback after a failed outer rewrite replays literals
+                # instead of re-executing the inner aggregates
+                result = PlanResult(stmt=alt, entry=entry, sql=sql)
+                try:
+                    _Rewriter(self, alt, entry, result).run()
+                    return result
+                except RewriteError as e:
+                    result.query = None
+                    result.fallback_reason = str(e)
+                    return result
         if outside is not None:
             return PlanResult(
                 stmt=stmt, entry=self.catalog.get(stmt.table), sql=sql,
@@ -170,6 +251,36 @@ class DruidPlanner:
             result.query = None
             result.fallback_reason = str(e)
         return result
+
+    def _inline_uncorrelated(self, stmt):
+        """Execute every uncorrelated scalar/IN/EXISTS subquery via
+        run_subquery and inline the results as literals. None when
+        nothing inlined, the statement still carries subquery constructs
+        (correlated shapes resolve to corr_* map nodes only the fallback
+        evaluator understands), or resolution failed."""
+        from tpu_olap.planner import fallback as fb
+        from tpu_olap.planner.exprutil import simplify_stmt
+        # correlation pre-scan BEFORE any execution: a correlated member
+        # can only resolve to corr_* map nodes we would discard, and
+        # _resolve_subqueries runs inner statements eagerly — bailing
+        # here keeps the heavy decorrelation work single-execution (it
+        # happens once, on the fallback path)
+        for sub in _collect_substmts(stmt):
+            if not fb._uncorrelated(sub):
+                return None
+        try:
+            resolved = fb._resolve_subqueries(
+                stmt, self.catalog, self.config, run=self.run_subquery)
+        except fb.FallbackError:
+            return None
+        if resolved is stmt:
+            return None
+        resolved = simplify_stmt(resolved)
+        if _outside_subset(resolved) is not None:
+            return None
+        if _contains_fallback_nodes(resolved):
+            return None
+        return resolved
 
 
 class _Rewriter:
@@ -473,6 +584,12 @@ class _Rewriter:
     # -------------------------------------------------------------- filters
 
     def _to_filter(self, e) -> F.FilterSpec:
+        if isinstance(e, Lit):
+            # constant predicates appear when subquery inlining folds
+            # e.g. EXISTS(...) to TRUE/FALSE
+            if e.value:
+                return None  # and_of drops the no-op conjunct
+            raise RewriteError("statically false predicate")
         if isinstance(e, BinOp) and e.op == "&&":
             return F.and_of(self._to_filter(e.left), self._to_filter(e.right))
         if isinstance(e, BinOp) and e.op == "||":
@@ -498,6 +615,25 @@ class _Rewriter:
                     return F.InFilter(col, tuple(vals), fn)
             col = self._filter_col(e.args[0])
             return F.InFilter(col, tuple(vals))
+        if isinstance(e, FuncCall) and e.name == "in_list_packed":
+            # inlined IN-subquery result: one Lit holding every value
+            vals = tuple(e.args[1].value)
+            lhs = e.args[0]
+            if not isinstance(lhs, Col):
+                ext = self._extraction_of(lhs)
+                if ext is not None:
+                    col, fn = ext
+                    return F.InFilter(col, vals, fn)
+            col = self._filter_col(lhs)
+            if self._col_type(col) is not ColumnType.STRING \
+                    and len(vals) > 8192:
+                # numeric in-lists broadcast rows x values on the device;
+                # string lists compile to a dictionary-sized table and
+                # have no such limit
+                raise RewriteError(
+                    f"packed numeric IN list of {len(vals)} values "
+                    "exceeds the device broadcast budget")
+            return F.InFilter(col, vals)
         if isinstance(e, FuncCall) and e.name == "like":
             col = self._filter_col(e.args[0])
             pat = e.args[1]
@@ -512,6 +648,14 @@ class _Rewriter:
                                           isinstance(right, FuncCall)):
                 left, right = right, left
                 op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+            if isinstance(right, Lit) and right.value is None:
+                # comparison with a NULL literal (e.g. an empty scalar
+                # subquery inlined as Lit(None)) matches no rows — the
+                # fallback's guard rule. SelectorFilter(col, None) would
+                # read it as IS NULL; IS NULL itself arrives as the
+                # is_null FuncCall, not a comparison.
+                raise RewriteError(
+                    "comparison with NULL literal matches no rows")
             if isinstance(right, Lit) and op in ("==", "!="):
                 ext = self._extraction_of(left)
                 if ext is not None:
@@ -631,6 +775,16 @@ class _Rewriter:
                 raise RewriteError(
                     f"regexp_extract over non-string column {col!r}")
             return col, RegexExtractionFn(e.args[1].value)
+        if e.name == "lookup_map" and len(e.args) == 2 and \
+                isinstance(e.args[1], Lit):
+            # subquery resolution inlines lookup() as lookup_map with the
+            # mapping items baked in; same extraction, no catalog read
+            from tpu_olap.ir.dimensions import LookupExtractionFn
+            col = self._check_col(e.args[0].name)
+            if self._col_type(col) is not ColumnType.STRING:
+                raise RewriteError(
+                    f"lookup over non-string column {col!r}")
+            return col, LookupExtractionFn(tuple(e.args[1].value))
         if e.name == "lookup" and len(e.args) == 2 and \
                 isinstance(e.args[1], Lit) and isinstance(e.args[1].value,
                                                           str):
