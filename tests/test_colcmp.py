@@ -89,14 +89,13 @@ def test_mesh_and_pallas_force():
         dev, fb, _ = run_both(e, sql)
         assert_frame_parity(dev, fb, ordered=False, label=tag)
         if tag == "pallas-force":
-            # columnComparison is deliberately NOT Pallas-whitelisted
-            # (the derived stream is not plumbed into the kernel's col
-            # refs); the plan must say so — the scatter kernel serves it
+            # columnComparison IS Pallas-whitelisted: the translation
+            # stream enters the kernel as an int32 row (no in-kernel
+            # gather), so the fused kernel must be active for this plan
             from tpu_olap.executor.lowering import lower
             plan = e.planner.plan(sql)
             phys = lower(plan.query, plan.entry.segments, e.config)
-            assert "non-simple" in (phys.pallas_reason or ""), \
-                phys.pallas_reason
+            assert phys.pallas_reason is None, phys.pallas_reason
 
 
 def test_scan_path(eng):

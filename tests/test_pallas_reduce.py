@@ -29,6 +29,13 @@ def _table(n=4096, seed=3):
     })
     df.loc[rng.random(n) < 0.05, "qty"] = np.nan  # nullable numeric
     df["qty"] = df["qty"].astype("Int64")
+    # columnComparison pairs, derived WITHOUT rng draws (keeps every
+    # other column's per-seed values stable): same-vocabulary roll plus
+    # deterministic out-of-vocabulary injections so the cross-dictionary
+    # translation map carries absent values
+    df["dest"] = np.roll(df["region"].to_numpy(), 5)
+    df.loc[df.index[::97], "dest"] = "zX"
+    df["color2"] = np.roll(df["color"].to_numpy(), 3)  # nullable pair
     return df
 
 
@@ -243,6 +250,31 @@ PRECOMPUTED_DIM_QUERIES = [
 
 @pytest.mark.parametrize("sql", PRECOMPUTED_DIM_QUERIES)
 def test_pallas_precomputed_dim_parity(sql):
+    _assert_parity(sql, check_eligible=True)
+
+
+COLCMP_QUERIES = [
+    # string pair via the translation stream (incl. absent-vocab values)
+    """SELECT color, sum(price) AS s, count(*) AS n FROM t
+       WHERE region = dest GROUP BY color ORDER BY color""",
+    # NOT composition: NULL rows match <>
+    """SELECT region, count(*) AS n FROM t
+       WHERE color <> color2 GROUP BY region ORDER BY region""",
+    # nullable string pair + second filter + numeric dim
+    """SELECT qty, sum(price) AS s FROM t
+       WHERE color = color2 AND qty BETWEEN 0 AND 30
+       GROUP BY qty ORDER BY qty""",
+    # numeric pair (nullable Int64 vs int64) inside an AND tree
+    """SELECT color, count(*) AS n FROM t
+       WHERE qty = price OR region = dest GROUP BY color ORDER BY color""",
+]
+
+
+@pytest.mark.parametrize("sql", COLCMP_QUERIES)
+def test_pallas_colcmp_parity(sql):
+    """columnComparison inside the Pallas kernel: the translation stream
+    enters as an ordinary int32 row and the compare is elementwise (no
+    in-kernel gather — Mosaic only lowers 2-D gathers)."""
     _assert_parity(sql, check_eligible=True)
 
 

@@ -55,6 +55,7 @@ def main():
         "minmax": T.MINMAX_QUERIES,
         "widened": T.WIDENED_QUERIES,
         "precomputed_dim": T.PRECOMPUTED_DIM_QUERIES,
+        "colcmp": T.COLCMP_QUERIES,
     }
     results = {}
     n_pass = n_fail = 0

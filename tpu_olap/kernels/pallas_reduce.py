@@ -189,6 +189,12 @@ def traced_const_names(plan, table, filter_fn) -> list:
     n = 8
     kcols = kernel_columns(plan)
     cols = {c: np.zeros(n, np.int64) for c in kcols}
+    # filter-derived streams are present in the real kernel env, so the
+    # trace must offer them too — otherwise the columnComparison closure
+    # would take its gather branch here and record a const the kernel
+    # never reads at runtime
+    for token, _, _ in plan.filter_streams:
+        cols["\0d:" + token] = np.zeros(n, np.int32)
     nulls = {c: np.zeros(n, bool) for c in plan.null_cols if c in kcols}
     materialize_virtuals(kernel_virtuals(plan), cols, nulls, np,
                          wide_ints=False)
@@ -262,7 +268,20 @@ def sum_bounds(plan, table) -> dict:
 
 
 _SIMPLE_FILTERS = (F.SelectorFilter, F.BoundFilter, F.InFilter,
-                   F.RegexFilter, F.LikeFilter)
+                   F.RegexFilter, F.LikeFilter, F.ColumnComparisonFilter)
+
+
+def _colcmp_nodes(spec):
+    """Every ColumnComparisonFilter in the tree."""
+    if spec is None:
+        return
+    if isinstance(spec, F.ColumnComparisonFilter):
+        yield spec
+    elif isinstance(spec, (F.AndFilter, F.OrFilter)):
+        for f in spec.fields:
+            yield from _colcmp_nodes(f)
+    elif isinstance(spec, F.NotFilter):
+        yield from _colcmp_nodes(spec.field)
 
 
 def _filter_ok(spec) -> bool:
@@ -339,6 +358,13 @@ def eligible(query, plan, table, config, filter_fn=None) -> str | None:
             return f"dimension kind {dp.kind!r}"
     if not _filter_ok(query.filter):
         return "filter tree has non-simple members"
+    for cc in _colcmp_nodes(query.filter):
+        # string pairs read the precomputed translation stream (int32 by
+        # construction); numeric pairs compare loaded columns — both need
+        # PHYSICAL columns (virtuals would evaluate un-bounded in-kernel)
+        for c in cc.dimensions:
+            if c not in table.schema:
+                return f"columnComparison over virtual column {c!r}"
 
     try:
         bounds = column_bounds(plan, table)
@@ -417,7 +443,8 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
     K_pad = n_kb * KB
 
     const_names = traced_const_names(plan, table, filter_fn)
-    col_names = [c for c in kernel_columns(plan) if c != TIME_COLUMN]
+    col_names = [c for c in kernel_columns(plan) if c != TIME_COLUMN] \
+        + ["\0d:" + t for t, _, _ in plan.filter_streams]
     n_mm = layout.n_minmax
     MM_pad = max(128, -(-n_mm // 128) * 128) if n_mm else 0
 

@@ -320,10 +320,13 @@ def _resolve_subqueries(stmt: SelectStmt, catalog, config,
                 raise FallbackError(
                     "IN subquery result exceeds fallback_scan_row_cap")
             # one packed Lit holding every value — per-value Lit nodes
-            # would allocate millions of objects for big subqueries
-            vals = tuple(None if pd.isna(v)
-                         else (v.item() if hasattr(v, "item") else v)
-                         for v in sub.iloc[:, 0])
+            # would allocate millions of objects for big subqueries.
+            # NULLs are DROPPED: `x IN (SELECT ...)` never matches on a
+            # NULL member (SQL; and the same rule the correlated
+            # decorrelation applies) — unlike a LITERAL in-list, where
+            # an explicit NULL matches null rows (Druid's in filter)
+            vals = tuple(v.item() if hasattr(v, "item") else v
+                         for v in sub.iloc[:, 0] if not pd.isna(v))
             return FuncCall("in_list_packed", (lhs, Lit(vals)))
         if isinstance(e, FuncCall) and e.name == "lookup" \
                 and len(e.args) == 2 and isinstance(e.args[1], Lit):

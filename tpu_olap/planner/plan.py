@@ -127,58 +127,45 @@ def _outside_subset(stmt) -> str | None:
     return None
 
 
-def _collect_substmts(stmt) -> list:
-    """Every nested SELECT reachable from the statement's expressions
-    (scalar Subquery nodes, in_subquery / exists call arguments)."""
-    from tpu_olap.ir.expr import Subquery
-    out = []
-
-    def walk(e):
-        if isinstance(e, Subquery):
-            out.append(e.stmt)
-            return
-        if isinstance(e, BinOp):
-            walk(e.left)
-            walk(e.right)
-        elif isinstance(e, FuncCall):
-            for a in e.args:
-                walk(a)
-
-    for e in ([x for x, _ in stmt.projections] + stmt.group_by
-              + [stmt.where, stmt.having]
-              + [o.expr for o in stmt.order_by]
-              + [j.on for j in stmt.joins]):
-        if e is not None:
-            walk(e)
-    return out
-
-
 _FALLBACK_FUNCS = ("corr_scalar_map", "corr_exists_map", "corr_in_map")
 
 
-def _contains_fallback_nodes(stmt) -> bool:
-    """True when subquery resolution left decorrelated map nodes that
-    only the fallback evaluator can apply per outer row."""
-    found = False
+def _scan_stmt_nodes(stmt):
+    """One traversal over every expression-bearing clause (via
+    map_stmt_exprs, the shared walker — incl. grouping_sets) collecting
+    what subquery inlining needs to know up front: nested SELECTs,
+    window-function presence (inlining would be discarded, so don't
+    execute anything), and decorrelated corr_* map nodes (only the
+    fallback evaluator applies those). Returns (substmts, has_window,
+    has_corr_nodes)."""
+    from tpu_olap.ir.expr import Subquery, WindowCall
+    from tpu_olap.planner.exprutil import map_stmt_exprs
+    subs: list = []
+    flags = {"window": False, "corr": False}
 
-    def walk(e):
-        nonlocal found
-        if isinstance(e, FuncCall):
-            if e.name in _FALLBACK_FUNCS:
-                found = True
+    def visit(e):
+        if isinstance(e, Subquery):
+            subs.append(e.stmt)
+        elif isinstance(e, WindowCall):
+            flags["window"] = True
             for a in e.args:
-                walk(a)
+                visit(a)
+            for p in e.partition_by:
+                visit(p)
+            for oe, _ in e.order_by:
+                visit(oe)
         elif isinstance(e, BinOp):
-            walk(e.left)
-            walk(e.right)
+            visit(e.left)
+            visit(e.right)
+        elif isinstance(e, FuncCall):
+            if e.name in _FALLBACK_FUNCS:
+                flags["corr"] = True
+            for a in e.args:
+                visit(a)
+        return e
 
-    for e in ([x for x, _ in stmt.projections] + stmt.group_by
-              + [stmt.where, stmt.having]
-              + [o.expr for o in stmt.order_by]
-              + [j.on for j in stmt.joins]):
-        if e is not None:
-            walk(e)
-    return found
+    map_stmt_exprs(stmt, visit)
+    return subs, flags["window"], flags["corr"]
 
 
 class DruidPlanner:
@@ -260,12 +247,15 @@ class DruidPlanner:
         evaluator understands), or resolution failed."""
         from tpu_olap.planner import fallback as fb
         from tpu_olap.planner.exprutil import simplify_stmt
-        # correlation pre-scan BEFORE any execution: a correlated member
-        # can only resolve to corr_* map nodes we would discard, and
-        # _resolve_subqueries runs inner statements eagerly — bailing
-        # here keeps the heavy decorrelation work single-execution (it
-        # happens once, on the fallback path)
-        for sub in _collect_substmts(stmt):
+        # pre-scan BEFORE any execution: a correlated member can only
+        # resolve to corr_* map nodes we would discard, a window
+        # function keeps the whole statement on the fallback anyway,
+        # and _resolve_subqueries runs inner statements eagerly —
+        # bailing here keeps that work single-execution
+        subs, has_window, _ = _scan_stmt_nodes(stmt)
+        if has_window or not subs:
+            return None
+        for sub in subs:
             if not fb._uncorrelated(sub):
                 return None
         try:
@@ -278,7 +268,8 @@ class DruidPlanner:
         resolved = simplify_stmt(resolved)
         if _outside_subset(resolved) is not None:
             return None
-        if _contains_fallback_nodes(resolved):
+        _, _, has_corr = _scan_stmt_nodes(resolved)
+        if has_corr:
             return None
         return resolved
 
@@ -685,16 +676,18 @@ class _Rewriter:
                     raise RewriteError(
                         f"comparison between string and numeric columns "
                         f"({ca!r}, {cb!r})")
+                # row-vs-row equality: the columnComparison filter
+                # (TPC-H Q5/Q7 `c_nation = s_nation`); <> composes as
+                # NOT, under which NULL rows match — same as the
+                # fallback's pandas semantics. Numeric pairs take the
+                # same filter (not ExpressionFilter) so they stay
+                # Pallas-eligible; ordered numeric comparisons fall
+                # through to the expression path below.
+                if op == "==":
+                    return F.ColumnComparisonFilter((ca, cb))
+                if op == "!=":
+                    return F.NotFilter(F.ColumnComparisonFilter((ca, cb)))
                 if sa:
-                    # row-vs-row string equality: the columnComparison
-                    # filter (TPC-H Q5/Q7 `c_nation = s_nation`); <>
-                    # composes as NOT, under which NULL rows match —
-                    # same as the fallback's pandas semantics
-                    if op == "==":
-                        return F.ColumnComparisonFilter((ca, cb))
-                    if op == "!=":
-                        return F.NotFilter(
-                            F.ColumnComparisonFilter((ca, cb)))
                     raise RewriteError(
                         "ordered comparison between string columns")
             if op == "!=":
