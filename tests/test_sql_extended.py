@@ -347,12 +347,19 @@ def test_cte_referenced_twice():
     assert got["g"].tolist() == [sums.idxmax()]
 
 
-def test_cte_in_join_rejected_clearly():
-    from tpu_olap.planner.sqlparse import SqlError
-    eng, _ = _engine()
-    with pytest.raises(SqlError, match="CTE 'x' referenced in a JOIN"):
-        eng.sql("WITH x AS (SELECT g FROM t) "
-                "SELECT t.g FROM t JOIN x ON t.g = x.g")
+def test_cte_in_join_executes():
+    """A CTE in JOIN position inlines as a derived join (round 4;
+    previously a legible rejection). Disjoint column names keep
+    qualifier stripping sound."""
+    eng, df = _engine()
+    got = eng.sql("WITH x AS (SELECT g AS jg, count(*) AS c FROM t "
+                  "GROUP BY g) "
+                  "SELECT g, c FROM t JOIN x ON g = jg "
+                  "GROUP BY g, c ORDER BY g")
+    cnt = df.groupby("g").size()
+    assert list(got["g"]) == sorted(cnt.index)
+    assert [int(x) for x in got["c"]] == \
+        [int(cnt[g]) for g in sorted(cnt.index)]
 
 
 def test_group_by_ordinal():

@@ -334,3 +334,107 @@ def test_non_equality_correlation_still_legible(eng):
         e.sql("""SELECT count(*) AS n FROM fact
                  WHERE v > (SELECT avg(f2.v) FROM fact f2
                             WHERE f2.k > fact.k)""")
+
+
+def test_derived_table_in_join(eng):
+    """JOIN (SELECT ...) alias — the reference handed these to full
+    Spark SQL (SURVEY.md §3.1); here the derived frame executes once
+    and joins like a dimension table, on the fallback path."""
+    e, fact, dim = eng
+    got = e.sql("""SELECT grp, sum(v * c) AS s FROM fact
+                   JOIN (SELECT k AS jk, count(*) AS c FROM fact
+                         GROUP BY k) q
+                   ON k = jk GROUP BY grp ORDER BY grp""")
+    assert not e.last_plan.rewritten
+    cnt = fact.groupby("k").size().rename("c").reset_index()
+    j = fact.merge(cnt, on="k")
+    exp = (j.v * j.c).groupby(j.grp).sum().sort_index()
+    assert list(got["grp"]) == list(exp.index)
+    assert [int(x) for x in got["s"]] == [int(x) for x in exp.values]
+
+
+def test_cte_in_join_position(eng):
+    """A CTE referenced in JOIN position inlines like the FROM position
+    (previously a legible rejection)."""
+    e, fact, dim = eng
+    got = e.sql("""WITH q AS (SELECT k AS jk, sum(v) AS tot FROM fact
+                              GROUP BY k)
+                   SELECT dname, tot FROM dim
+                   JOIN q ON dk = jk ORDER BY dname""")
+    tot = fact.groupby("k").v.sum()
+    exp = dim[dim.dk.isin(tot.index)].sort_values("dname")
+    assert list(got["dname"]) == list(exp["dname"])
+    assert [int(x) for x in got["tot"]] == \
+        [int(tot[k]) for k in exp["dk"]]
+
+
+def test_tpch_q15_comma_join_cte(eng):
+    """TPC-H Q15's actual spelling: a comma join of an aggregating CTE
+    plus a scalar subquery over the same CTE."""
+    e, fact, dim = eng
+    got = e.sql("""WITH rev AS (SELECT k AS sk, sum(v) AS total
+                                FROM fact GROUP BY k)
+                   SELECT dname, total FROM dim, rev
+                   WHERE dk = sk AND total = (SELECT max(total) FROM rev)""")
+    tot = fact[fact.k.isin(dim.dk)].groupby("k").v.sum()
+    best = tot.idxmax()
+    assert got["dname"].tolist() == \
+        dim[dim.dk == best]["dname"].tolist()
+    assert [int(x) for x in got["total"]] == [int(tot.max())]
+
+
+def test_left_join_derived_preserves_unmatched(eng):
+    e, fact, dim = eng
+    got = e.sql("""SELECT dname, c FROM dim
+                   LEFT JOIN (SELECT k AS jk, count(*) AS c FROM fact
+                              GROUP BY k) q
+                   ON dk = jk ORDER BY dname""")
+    cnt = fact.groupby("k").size()
+    exp = [int(cnt.get(k, 0)) or None for k in dim.sort_values("dname").dk]
+    assert [None if pd.isna(x) else int(x) for x in got["c"]] == exp
+
+
+def test_derived_join_ambiguous_columns_rejected(eng):
+    """A derived join whose output reuses a base-table column name is
+    ambiguous after qualifier stripping — reject, never mis-resolve."""
+    e, _, _ = eng
+    with pytest.raises(Exception, match="alias|disambiguate"):
+        e.sql("""SELECT q.v FROM fact
+                 JOIN (SELECT k, max(v) AS v FROM fact GROUP BY k) q
+                 ON fact.k = q.k""")
+
+
+def test_correlated_derived_join_rejected_not_wrong(eng):
+    """A non-LATERAL derived table cannot see the outer row (standard
+    SQL); an outer-table qualifier inside the body must reject, never
+    silently strip onto a same-named inner column (code-review repro:
+    fact also has the outer column's name)."""
+    e, fact, dim = eng
+    e.register_table("dim2", pd.DataFrame(
+        {"dk": [1, 2], "v": [50, 60]}), accelerate=False)
+    with pytest.raises(Exception, match="correlated|not supported"):
+        e.sql("""SELECT dname FROM dim
+                 JOIN (SELECT k, count(*) AS c FROM fact
+                       WHERE v < dim.v GROUP BY k) q
+                 ON dk = k""")
+
+
+def test_correlated_from_derived_rejected_not_wrong(eng):
+    """Same contract for FROM-position derived tables."""
+    e, _, _ = eng
+    with pytest.raises(Exception, match="correlated|not supported"):
+        e.sql("""SELECT c FROM (SELECT count(*) AS c FROM fact
+                                WHERE fact.v < dim.dk) q""")
+
+
+def test_from_derived_join_ambiguous_columns_rejected(eng):
+    """FROM-position derived table joined against a table that reuses
+    one of its output names: same ambiguity class as the JOIN-position
+    twin — reject, never mis-resolve (code-review repro)."""
+    e, _, _ = eng
+    e.register_table("vdim", pd.DataFrame(
+        {"dk": [1, 2], "v": [100, 200]}), accelerate=False)
+    with pytest.raises(Exception, match="alias|disambiguate"):
+        e.sql("""SELECT vdim.v AS dv
+                 FROM (SELECT k, sum(v) AS v FROM fact GROUP BY k) q
+                 JOIN vdim ON k = dk""")

@@ -37,7 +37,10 @@ def execute_fallback(stmt, catalog, config) -> pd.DataFrame:
         return _execute_union(stmt, catalog, config)
     stmt = _resolve_subqueries(stmt, catalog, config)
     if stmt.derived is not None:
-        # FROM (SELECT ...) alias: the derived result is the base frame
+        # FROM (SELECT ...) alias: the derived result is the base frame.
+        # Its scope is its own — reject outer-table qualifiers inside
+        # the body (they would strip onto the inner frame silently).
+        _check_uncorrelated(stmt.derived)
         df = execute_fallback(stmt.derived, catalog, config)
         time_col = None
     else:
@@ -61,7 +64,7 @@ def execute_fallback(stmt, catalog, config) -> pd.DataFrame:
             # the same rows)
             df = df.sort_values(time_col, kind="stable")
 
-    df = _join_and_filter(stmt, df, catalog, time_col)
+    df = _join_and_filter(stmt, df, catalog, time_col, config)
 
     out_names = []
     exprs = []
@@ -227,6 +230,8 @@ def _check_uncorrelated(stmt):
             walk_expr(item.expr, tables)
         for j in s.joins:
             walk_expr(j.on, tables)
+            if j.derived is not None:
+                walk_stmt(j.derived)
         if s.derived is not None:
             walk_stmt(s.derived)
 
@@ -607,31 +612,61 @@ def _merge_one(df, other, j, lcol, rcol, extras, time_col):
                              if c in out.columns])
 
 
-def _join_and_filter(stmt, df, catalog, time_col):
+def _join_and_filter(stmt, df, catalog, time_col, config,
+                     derived_cache=None):
     """Apply the statement's joins (equi-joins; conditions from ON or
     WHERE) and residual WHERE conjuncts to one frame. Fixed point over
     the join list: a snowflake chain's parent may be listed after its
     child, and the link column only appears once the parent merges.
     RIGHT/FULL OUTER joins are order-sensitive, so their presence pins
-    strict listed-order processing (no deferral)."""
+    strict listed-order processing (no deferral). The chunked drivers
+    pass a shared `derived_cache` so a derived-join subquery executes
+    once per query, not once per chunk."""
+    derived_frames = derived_cache if derived_cache is not None else {}
+
+    def frame_of(j):
+        if j.derived is not None:
+            # JOIN (SELECT ...) alias / JOIN-position CTE: its scope is
+            # its own — an outer-table qualifier inside the body would
+            # be silently stripped onto the inner frame by the
+            # evaluator, so reject correlation up front (non-LATERAL
+            # derived tables cannot see the outer row in standard SQL)
+            if id(j) not in derived_frames:
+                _check_uncorrelated(j.derived)
+                derived_frames[id(j)] = execute_fallback(
+                    j.derived, catalog, config)
+            return derived_frames[id(j)]
+        return catalog.get(j.table).frame
+
     if stmt.joins and (stmt.table_alias is not None
-                       or any(j.alias is not None for j in stmt.joins)):
+                       or stmt.derived is not None
+                       or any(j.alias is not None or j.derived is not None
+                              for j in stmt.joins)):
         # the evaluator resolves qualified refs by STRIPPING the
-        # qualifier, which is only sound when every qualifier maps to a
-        # distinct table frame — an aliased multi-table scope (e.g. a
-        # self-join `t a JOIN t b`) would silently read the wrong frame;
-        # reject instead (single-table aliases, incl. inside correlated
-        # subqueries, are fine and used by decorrelation)
-        raise FallbackError(
-            "table aliases in a multi-table FROM are not supported "
-            "(qualified refs would not disambiguate same-named columns)")
+        # qualifier, which is only sound when every qualifier maps to
+        # distinctly-named columns — in an aliased multi-table scope with
+        # same-named columns (e.g. a self-join `t a JOIN t b`) a stripped
+        # ref would silently read the wrong frame. Allow the scope when
+        # column names are pairwise disjoint (USING keys coalesce, so
+        # they are exempt); reject the ambiguous remainder legibly.
+        seen = set(df.columns)
+        clash = set()
+        for j in stmt.joins:
+            cols = set(frame_of(j).columns) - set(j.using or ())
+            clash |= cols & seen
+            seen |= cols
+        if clash:
+            raise FallbackError(
+                "aliased multi-table FROM with same-named columns is not "
+                "supported (qualified refs would not disambiguate "
+                f"{sorted(clash)[:5]})")
     where_conjs = _split_and(stmt.where)
     pending = list(stmt.joins)
     strict = any(j.kind in ("right", "full") for j in pending)
     while pending:
         still = []
         for j in pending:
-            other = catalog.get(j.table).frame
+            other = frame_of(j)
             if j.kind == "cross":
                 df = df.merge(other, how="cross",
                               suffixes=("", f"__{j.table}"))
@@ -940,13 +975,17 @@ def _execute_chunked(stmt: SelectStmt, entry, catalog, config):
     star_expand = any(isinstance(e, Col) and e.name == "*"
                       for e, _ in stmt.projections)
     first = None
+    dcache: dict = {}  # derived-join frames execute once per query,
+    # shared across the schema probe and the chunk loops
     if star_expand:
         first = next(chunks, None)
         if first is None:
             return pd.DataFrame()
     for e, alias in stmt.projections:
         if isinstance(e, Col) and e.name == "*":
-            base = _join_and_filter(stmt, first.iloc[:0], catalog, time_col)
+            base = _join_and_filter(stmt, first.iloc[:0], catalog,
+                                    time_col, config,
+                                    derived_cache=dcache)
             for c in base.columns:
                 out_names.append(c)
                 exprs.append(Col(c))
@@ -983,14 +1022,15 @@ def _execute_chunked(stmt: SelectStmt, entry, catalog, config):
 
     if group_exprs or has_agg:
         return _chunked_aggregate(stmt, chunks, exprs, out_names,
-                                  group_exprs, catalog, time_col,
-                                  pair_cap=config.fallback_scan_row_cap)
+                                  group_exprs, catalog, time_col, config,
+                                  pair_cap=config.fallback_scan_row_cap,
+                                  derived_cache=dcache)
     return _chunked_scan(stmt, chunks, exprs, out_names, catalog,
-                         time_col, config)
+                         time_col, config, derived_cache=dcache)
 
 
 def _chunked_scan(stmt, chunks, exprs, out_names, catalog, time_col,
-                  config):
+                  config, derived_cache=None):
     order_exprs = {}
     for i, item in enumerate(stmt.order_by):
         name = _auto_name(item.expr)
@@ -1006,8 +1046,10 @@ def _chunked_scan(stmt, chunks, exprs, out_names, catalog, time_col,
     # "time-sorted within the first chunks that satisfy the limit")
     time_sort = need is not None and time_col is not None
     parts, total = [], 0
+    dcache = derived_cache if derived_cache is not None else {}
     for chunk in chunks:
-        df = _join_and_filter(stmt, chunk, catalog, time_col)
+        df = _join_and_filter(stmt, chunk, catalog, time_col, config,
+                              derived_cache=dcache)
         if not len(df):
             continue
         part = pd.DataFrame(
@@ -1041,7 +1083,8 @@ def _chunked_scan(stmt, chunks, exprs, out_names, catalog, time_col,
 
 
 def _chunked_aggregate(stmt, chunks, exprs, out_names, group_exprs,
-                       catalog, time_col, pair_cap=20_000_000):
+                       catalog, time_col, config,
+                       pair_cap=20_000_000, derived_cache=None):
     # every aggregate call reachable from projections / HAVING / ORDER BY
     agg_calls: dict = {}
     for e in exprs:
@@ -1154,8 +1197,10 @@ def _chunked_aggregate(stmt, chunks, exprs, out_names, group_exprs,
 
     pending_rows = 0
     empty_proto = None   # 0-row joined frame with the real schema
+    dcache = derived_cache if derived_cache is not None else {}
     for chunk in chunks:
-        df = _join_and_filter(stmt, chunk, catalog, time_col)
+        df = _join_and_filter(stmt, chunk, catalog, time_col, config,
+                              derived_cache=dcache)
         if empty_proto is None:
             empty_proto = df.iloc[0:0]
         if not len(df):

@@ -354,6 +354,9 @@ class _Rewriter:
             return conjuncts
         if any(j.using is not None for j in stmt.joins):
             raise RewriteError("USING joins execute on the fallback path")
+        if any(j.derived is not None for j in stmt.joins):
+            raise RewriteError("derived table / CTE in JOIN position "
+                               "executes on the fallback path")
         star = self.entry.star
         if star is None:
             raise RewriteError("join query but no star schema declared")

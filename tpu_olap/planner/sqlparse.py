@@ -102,6 +102,10 @@ class JoinClause:
     # unqualified self-equality is a tautology after qualifier stripping
     # (it would silently join on nothing)
     using: tuple | None = None
+    # JOIN (SELECT ...) alias — the derived statement; `table` holds the
+    # alias (like SelectStmt.derived). Also set by _inline_ctes for a
+    # CTE referenced in JOIN position. Fallback-only.
+    derived: object = None
 
 
 @dataclass
@@ -190,6 +194,23 @@ class _Parser:
         if self.peek()[0] == "name" and "." not in self.peek()[1]:
             return self.take("name")
         return None
+
+    def _join_target(self):
+        """Join target: a table name, or a derived table
+        `(SELECT ...) [AS] alias` (the reference served these through
+        full Spark SQL, SURVEY.md §3.1). Returns (name, derived, alias);
+        for a derived target `name` holds the alias and `alias` is None,
+        mirroring how FROM-position derived tables are represented."""
+        if self.peek() == ("op", "("):
+            self.take()
+            sub = self.statement_in_parens()
+            self.take("op", ")")
+            if self.at_kw("as"):
+                self.take()
+            name = self.take("name") if self.peek()[0] == "name" \
+                else "__derived"
+            return name, sub, None
+        return self.take("name"), None, self._table_alias()
 
     # ---- statement -------------------------------------------------------
 
@@ -294,15 +315,17 @@ class _Parser:
         while True:
             if self.peek() == ("op", ","):
                 self.take()
-                stmt.joins.append(JoinClause(self.take("name"), None,
-                                             alias=self._table_alias()))
+                tname, tderived, talias = self._join_target()
+                stmt.joins.append(JoinClause(tname, None, alias=talias,
+                                             derived=tderived))
                 continue
             if self.at_kw("cross"):
                 self.take()
                 self.take_kw("join")
-                stmt.joins.append(JoinClause(self.take("name"), None,
-                                             "cross",
-                                             alias=self._table_alias()))
+                tname, tderived, talias = self._join_target()
+                stmt.joins.append(JoinClause(tname, None, "cross",
+                                             alias=talias,
+                                             derived=tderived))
                 continue
             if self.at_kw("join", "inner", "left", "right", "full"):
                 kind = "inner"
@@ -313,8 +336,7 @@ class _Parser:
                 elif self.at_kw("inner"):
                     self.take()
                 self.take_kw("join")
-                tname = self.take("name")
-                talias = self._table_alias()
+                tname, tderived, talias = self._join_target()
                 if self.at_kw("using"):
                     self.take()
                     self.take("op", "(")
@@ -325,12 +347,13 @@ class _Parser:
                     self.take("op", ")")
                     stmt.joins.append(JoinClause(
                         tname, None, kind, alias=talias,
-                        using=tuple(ucols)))
+                        using=tuple(ucols), derived=tderived))
                     continue
                 self.take_kw("on")
                 cond = self.expr()
                 stmt.joins.append(JoinClause(tname, cond, kind,
-                                             alias=talias))
+                                             alias=talias,
+                                             derived=tderived))
                 continue
             break
         if self.at_kw("where"):
@@ -764,11 +787,12 @@ def _inline_ctes(stmt, ctes: dict):
         elif s.table in ctes:
             s.derived = copy.deepcopy(ctes[s.table])
         for j in s.joins:
-            if j.table in ctes:
-                raise SqlError(
-                    f"CTE {j.table!r} referenced in a JOIN is not "
-                    "supported (inline it as the FROM table or a "
-                    "subquery)")
+            if j.derived is not None:
+                walk_stmt(j.derived)
+            elif j.table in ctes:
+                # JOIN-position CTE reference: same inlining as FROM
+                # position (bodies in `ctes` are already fully inlined)
+                j.derived = copy.deepcopy(ctes[j.table])
             walk_expr(j.on)  # subqueries inside ON may reference CTEs
         for e, _ in s.projections:
             walk_expr(e)
