@@ -140,38 +140,40 @@ def test_pallas_tuning_file_supplies_auto_default(tmp_path, monkeypatch):
     """With EngineConfig.pallas_auto_flop_budget unset, the 'auto'
     policy reads the hardware-fitted default from
     planner/pallas_tuning.json (written by tools/fit_pallas_budget.py
-    from the on-chip A/B)."""
+    from the on-chip A/B). The shipped file is never touched: the
+    reader's path is monkeypatched to a tmp copy."""
     import json
-    import os
     import tpu_olap.executor.lowering as L
     from tpu_olap.executor.lowering import lower
-    path = os.path.join(os.path.dirname(L.__file__), "..", "planner",
-                        "pallas_tuning.json")
+    path = tmp_path / "pallas_tuning.json"
+    monkeypatch.setattr(L, "_TUNING_PATH", str(path))
     df = _table()
-    q = "SELECT city, sum(v) AS s FROM t GROUP BY city"
 
-    def plan_with_tuning(budget):
+    def plan_on_tpu(sql):
         L._tuning_cache = None  # drop the memo so the file is re-read
         e = Engine(EngineConfig(use_pallas="auto"))
         e.register_table("t", df, time_column="ts")
-        p = e.planner.plan(q)
-        orig = L._default_backend
-        L._default_backend = lambda: "tpu"
+        p = e.planner.plan(sql)
+        monkeypatch.setattr(L, "_default_backend", lambda: "tpu")
         try:
             return lower(p.query, p.entry.segments, e.config)
         finally:
-            L._default_backend = orig
+            monkeypatch.undo()
+            monkeypatch.setattr(L, "_TUNING_PATH", str(path))
             L._tuning_cache = None
 
-    assert not os.path.exists(path)  # never committed; test-scoped only
-    try:
-        with open(path, "w") as f:
-            json.dump({"auto_flop_budget": 1.0}, f)
-        gated = plan_with_tuning(1.0)
-        assert gated.pallas_reason is not None
-        assert "FLOP" in gated.pallas_reason
-    finally:
-        os.remove(path)
+    path.write_text(json.dumps({"auto_flop_budget": 1.0}))
+    gated = plan_on_tpu("SELECT city, sum(v) AS s FROM t GROUP BY city")
+    assert gated.pallas_reason is not None
+    assert "FLOP" in gated.pallas_reason
+
+    # hardware-fitted ungrouped policy: K==1 takes the generic fused
+    # reduce when the A/B said the kernel loses there
+    path.write_text(json.dumps({"auto_ungrouped_pallas": False}))
+    phys2 = plan_on_tpu("SELECT sum(v) AS s FROM t")
+    assert phys2.pallas_reason is not None
+    assert "ungrouped" in phys2.pallas_reason
+    L._tuning_cache = None
 
 
 def test_derived_stream_under_mesh_parity():

@@ -1,4 +1,4 @@
-"""Fit the pallas-vs-scatter crossover from the on-chip A/B pair and
+"""Fit the pallas-vs-generic crossover from the on-chip A/B pair and
 write it as the 'auto' policy default (docs/PERF_MODEL.md decision
 procedure #1; VERDICT r3 weak #1).
 
@@ -6,17 +6,26 @@ Inputs: BENCH_TPU_AUTO_r04.json (fresh auto run, this round's code) and
 BENCH_TPU_PALLAS_never.json (XLA scatter leg, same data/scale). For each
 SSB query the one-hot FLOP product is computed by lowering the query
 locally (K is scale-free: SSB dimension cardinalities do not grow with
-the fact row count), then:
+the fact row count).
 
-- queries where auto is FASTER than never keep the Pallas kernel: the
-  budget must sit above their FLOP product;
-- queries where auto is SLOWER (beyond a noise margin) must take the
-  scatter path: the budget must sit below theirs.
+The first on-chip A/B (2026-07-31) showed TWO regimes, not the single
+cap the perf model hypothesized:
 
-The fitted budget is the log-midpoint of the gap; contradictions (a
-losing query below a winning one) widen the margin until consistent.
+- **K == 1 (ungrouped)**: no scatter is involved either way — the
+  alternative to the Pallas kernel is XLA's fused masked reduce, which
+  wins by a fixed ~20 ms dispatch margin. This is a structural class,
+  not a FLOP threshold: fitted as `auto_ungrouped_pallas` (False when
+  the K=1 queries lose beyond the noise margin).
+- **K > 1 (grouped)**: the XLA scatter path measured ~500 ms nearly
+  flat across K at SF1 while the one-hot MXU kernel won every grouped
+  query by 4-6x, up through q2.2's 1.26e13 FLOPs. The O(K·n) asymptote
+  must still lose eventually (SF100/chip projections in PERF_MODEL.md),
+  so `auto_flop_budget` is fitted as an upper cap ONLY from grouped
+  losses sitting above every grouped win; with no grouped loss observed
+  there is no cap (null) and the SF10 leg's larger n can add one later.
+
 Writes tpu_olap/planner/pallas_tuning.json (consumed by
-lowering._tuned_flop_budget as the default when EngineConfig leaves
+lowering._tuned_pallas_policy as the default when EngineConfig leaves
 pallas_auto_flop_budget unset).
 
 Usage: python tools/fit_pallas_budget.py  [exit 3 if inputs missing]
@@ -63,7 +72,7 @@ def main():
     n_rows = runs["auto"]["detail"]["rows"]
     seg = eng.catalog.get("lineorder").segments
     block = seg.block_rows
-    flops = {}
+    flops, groups = {}, {}
     for qname, sql in QUERIES.items():
         plan = eng.planner.plan(sql)
         phys = lower(plan.query, plan.entry.segments, eng.config)
@@ -71,39 +80,63 @@ def main():
         k_pad = -(-phys.total_groups // kb) * kb
         n_pad = -(-n_rows // block) * block
         flops[qname] = 2.0 * k_pad * n_pad * 128
+        groups[qname] = phys.total_groups
 
     auto = runs["auto"]["detail"]["per_query_p50_ms"]
     never = runs["never"]["detail"]["per_query_p50_ms"]
-    wins = [flops[q] for q in QUERIES if auto[q] * NOISE < never[q]]
-    losses = [flops[q] for q in QUERIES if auto[q] > never[q] * NOISE]
+
+    k1 = [q for q in QUERIES if groups[q] == 1]
+    grouped = [q for q in QUERIES if groups[q] > 1]
+
+    # regime 1: ungrouped — a single yes/no, not a threshold
+    ungrouped_pallas = None
+    if k1:
+        losing = [q for q in k1 if auto[q] > never[q] * NOISE]
+        winning = [q for q in k1 if auto[q] * NOISE < never[q]]
+        if losing and not winning:
+            ungrouped_pallas = False
+        elif winning and not losing:
+            ungrouped_pallas = True
+        # mixed/noise-bound: leave None (keep the kernel; it is within
+        # the noise margin either way)
+
+    # regime 2: grouped — upper FLOP cap, only where losses sit above
+    # every win (the O(K·n) asymptote)
+    wins = [flops[q] for q in grouped if auto[q] * NOISE < never[q]]
+    losses = [flops[q] for q in grouped if auto[q] > never[q] * NOISE]
     lo = max(wins) if wins else None       # keep pallas at least here
-    hi = min(losses) if losses else None   # force scatter from here
+    hi = min([f for f in losses if lo is None or f > lo] or [None]) \
+        if losses else None
 
     if hi is None:
-        budget = None          # pallas never lost: no cap
-        verdict = "pallas never slower: no cap written"
-    elif lo is None or lo >= hi:
-        budget = hi * 0.99     # cap just below the cheapest loss
-        verdict = ("cap below the cheapest losing query"
-                   if lo is None else
-                   "win/loss bands overlap: conservative cap below "
-                   "the cheapest loss")
+        budget = None
+        verdict = ("no grouped loss observed: no cap"
+                   if not losses else
+                   "grouped losses all below wins: noise, no cap")
+    elif lo is None:
+        budget = hi * 0.99
+        verdict = "cap below the cheapest grouped loss"
     else:
         budget = math.exp((math.log(lo) + math.log(hi)) / 2)
-        verdict = "log-midpoint of the win/loss gap"
+        verdict = "log-midpoint of the grouped win/loss gap"
 
     out = {
         "auto_flop_budget": budget,
+        "auto_ungrouped_pallas": ungrouped_pallas,
         "fit": {"verdict": verdict, "noise_margin": NOISE,
                 "rows": n_rows,
-                "per_query": {q: {"flops": flops[q], "auto_ms": auto[q],
+                "ungrouped_queries": k1,
+                "per_query": {q: {"flops": flops[q], "groups": groups[q],
+                                  "auto_ms": auto[q],
                                   "never_ms": never[q]}
                               for q in sorted(QUERIES)}},
     }
     path = os.path.join(REPO, "tpu_olap", "planner", "pallas_tuning.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
-    print(json.dumps({"auto_flop_budget": budget, "verdict": verdict}))
+    print(json.dumps({"auto_flop_budget": budget,
+                      "auto_ungrouped_pallas": ungrouped_pallas,
+                      "verdict": verdict}))
     return 0
 
 

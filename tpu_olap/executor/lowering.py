@@ -12,6 +12,7 @@ rewritable" -> fallback (SURVEY.md §2 property 2).
 
 from __future__ import annotations
 
+import os.path
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -431,9 +432,18 @@ def _maybe_use_pallas(plan, query, table, config, filter_fn, imask_fn=None):
     if reason is not None:
         plan.pallas_reason = reason
         return
+    tuning = _tuned_pallas_policy()
+    if (config.use_pallas == "auto" and plan.total_groups == 1
+            and tuning.get("auto_ungrouped_pallas") is False):
+        # hardware-fitted: with no grouping there is no scatter to beat —
+        # XLA's fused masked reduce wins by a fixed dispatch margin
+        # (tools/fit_pallas_budget.py, first on-chip A/B)
+        plan.pallas_reason = ("auto: ungrouped reduce is faster on the "
+                              "generic kernel (hardware-fitted policy)")
+        return
     budget = config.pallas_auto_flop_budget
     if budget is None:
-        budget = _tuned_flop_budget()
+        budget = tuning.get("auto_flop_budget")
     if config.use_pallas == "auto" and budget is not None:
         # the one-hot reduce is O(K·n): K_pad*n*H_pad*2 FLOPs
         # (docs/PERF_MODEL.md). Past the budget the XLA scatter kernel
@@ -460,20 +470,25 @@ def _default_backend() -> str:
 
 
 _tuning_cache: dict | None = None
+# module constant so tests can monkeypatch the location instead of
+# rewriting the shipped fitted file in place
+_TUNING_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "planner", "pallas_tuning.json")
 
 
-def _tuned_flop_budget():
-    """Hardware-fitted default for the pallas-vs-scatter crossover:
+def _tuned_pallas_policy() -> dict:
+    """Hardware-fitted defaults for the 'auto' Pallas policy:
     tools/fit_pallas_budget.py writes planner/pallas_tuning.json from
     the on-chip A/B pair (docs/PERF_MODEL.md decision procedure #1).
-    An explicit EngineConfig.pallas_auto_flop_budget overrides it;
-    absent file = no cap (pre-A/B behavior)."""
+    Keys: auto_ungrouped_pallas (False = K==1 queries take the generic
+    fused reduce) and auto_flop_budget (upper cap on the one-hot FLOP
+    product; an explicit EngineConfig.pallas_auto_flop_budget overrides
+    it). Absent file = empty policy (pre-A/B behavior)."""
     global _tuning_cache
     if _tuning_cache is None:
         import json
-        import os
-        path = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "planner", "pallas_tuning.json")
+        path = _TUNING_PATH
         data = {}
         if os.path.exists(path):
             try:
@@ -482,7 +497,7 @@ def _tuned_flop_budget():
             except Exception:  # noqa: BLE001 — a bad file must not
                 data = {}      # break query planning
         _tuning_cache = data
-    return _tuning_cache.get("auto_flop_budget")
+    return _tuning_cache
 
 
 def _lower_mask(query, table, config) -> PhysicalPlan:
