@@ -41,8 +41,23 @@ def main():
     import pandas as pd
     import test_pallas_reduce as T
     from tpu_olap import Engine
+    from tpu_olap.bench.parity import assert_frame_parity
     from tpu_olap.executor import EngineConfig
     from tpu_olap.executor.lowering import lower
+
+    def compare(a, b, key):
+        """Value-level parity (dtype-normalizing, float-tolerant: the
+        two device paths may legally disagree on e.g. float64-vs-object
+        for a nullable group key). On mismatch, embed both frames so a
+        failure banked through the probe is diagnosable offline."""
+        try:
+            assert_frame_parity(a, b, ordered=True, label=key)
+        except Exception as err:
+            raise AssertionError(
+                f"{err}\n--- never ({dict(a.dtypes.astype(str))}):\n"
+                f"{a.head(24)}\n"
+                f"--- force ({dict(b.dtypes.astype(str))}):\n"
+                f"{b.head(24)}") from err
 
     plain = Engine(EngineConfig(use_pallas="never"))
     forced = Engine(EngineConfig(use_pallas="force"))
@@ -68,7 +83,7 @@ def main():
                 b = forced.sql(sql)
                 plan = forced.planner.plan(sql)
                 phys = lower(plan.query, plan.entry.segments, forced.config)
-                pd.testing.assert_frame_equal(a, b)
+                compare(a, b, key)
                 results[key] = {
                     "ok": True,
                     "pallas_active": phys.pallas_reason is None,
@@ -77,7 +92,7 @@ def main():
                 n_pass += 1
             except Exception:  # noqa: BLE001 — recorded per-query
                 results[key] = {"ok": False,
-                                "error": traceback.format_exc()[-1200:],
+                                "error": traceback.format_exc()[-2400:],
                                 "sql": sql}
                 n_fail += 1
             print(f"[pallas-hw] {key}: "
@@ -90,12 +105,12 @@ def main():
         f2.register_table("t", df, time_column="ts", block_rows=512)
         q = ("SELECT region, color, sum(price) AS s, count(*) AS n FROM t "
              "GROUP BY region, color ORDER BY region, color")
-        pd.testing.assert_frame_equal(plain.sql(q), f2.sql(q))
+        compare(plain.sql(q), f2.sql(q), "k_tiling")
         results["k_tiling"] = {"ok": True}
         n_pass += 1
     except Exception:  # noqa: BLE001
         results["k_tiling"] = {"ok": False,
-                               "error": traceback.format_exc()[-1200:]}
+                               "error": traceback.format_exc()[-2400:]}
         n_fail += 1
 
     # full-int32-range sums: every 4-bit plane + half-sum recombination
@@ -116,12 +131,12 @@ def main():
             e.register_table("big_t", big, time_column="ts", block_rows=512)
         for q in ("SELECT g, sum(big) AS s FROM big_t GROUP BY g ORDER BY g",
                   "SELECT g, sum(neg) AS s FROM big_t GROUP BY g ORDER BY g"):
-            pd.testing.assert_frame_equal(p2.sql(q), f3.sql(q))
+            compare(p2.sql(q), f3.sql(q), "large_values")
         results["large_values"] = {"ok": True}
         n_pass += 1
     except Exception:  # noqa: BLE001
         results["large_values"] = {"ok": False,
-                                   "error": traceback.format_exc()[-1200:]}
+                                   "error": traceback.format_exc()[-2400:]}
         n_fail += 1
 
     out = {"backend": backend, "passed": n_pass, "failed": n_fail,
