@@ -438,3 +438,25 @@ def test_from_derived_join_ambiguous_columns_rejected(eng):
         e.sql("""SELECT vdim.v AS dv
                  FROM (SELECT k, sum(v) AS v FROM fact GROUP BY k) q
                  JOIN vdim ON k = dk""")
+
+
+def test_sum_avg_distinct(eng):
+    """SUM(DISTINCT)/AVG(DISTINCT) on the fallback path; MIN/MAX
+    DISTINCT are no-ops; other DISTINCT aggs reject legibly."""
+    e, fact, _ = eng
+    got = e.sql("SELECT grp, sum(DISTINCT v) AS sd, avg(DISTINCT v) AS ad,"
+                " min(DISTINCT v) AS mn FROM fact GROUP BY grp ORDER BY grp")
+    assert not e.last_plan.rewritten
+    exp = fact.groupby("grp").v.agg(
+        sd=lambda s: s.dropna().drop_duplicates().sum(),
+        ad=lambda s: s.dropna().drop_duplicates().mean(),
+        mn="min").sort_index()
+    assert [int(x) for x in got["sd"]] == [int(x) for x in exp["sd"]]
+    assert [round(float(x), 9) for x in got["ad"]] == \
+        [round(float(x), 9) for x in exp["ad"]]
+    assert [int(x) for x in got["mn"]] == [int(x) for x in exp["mn"]]
+    # global (ungrouped) spelling
+    g = e.sql("SELECT sum(DISTINCT v) AS sd FROM fact")
+    assert int(g["sd"].iloc[0]) == int(fact.v.drop_duplicates().sum())
+    with pytest.raises(Exception, match="DISTINCT"):
+        e.sql("SELECT theta_sketch(DISTINCT v) FROM fact")

@@ -1,0 +1,102 @@
+"""On-chip tiling/path sweep for the grouped SSB outliers (round 4).
+
+q2.2 (K=8008) costs ~240 ms warm at SF1 — ~173 ms compute over the
+67.5 ms tunnel RTT floor, ~37% MXU efficiency on the one-hot reduce
+(docs/PERF_MODEL.md). This sweeps the knobs that could close the gap,
+on real hardware, for the three worst grouped queries:
+
+- pallas_k_per_block x pallas_rows_per_block tile shapes (MXU feed);
+- the sparse sort-based path (pallas_group_cap below K forces it) —
+  never benchmarked on hardware against the dense one-hot.
+
+Writes PALLAS_SWEEP_TPU.json; exits 3 on CPU (never banked as hardware
+evidence). Dataset comes from bench.py's cached SF1 parquet.
+
+Usage: python tools/sweep_pallas_tpu.py
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+QUERIES = ("q2.2", "q4.3", "q3.2")
+ITERS = 5
+
+
+def main():
+    import jax
+    if jax.default_backend() == "cpu":
+        print("backend is cpu; refusing to bank", file=sys.stderr)
+        return 3
+
+    import bench as B
+    from tpu_olap import Engine
+    from tpu_olap.bench import QUERIES as SSB, register_ssb_parquet
+    from tpu_olap.executor import EngineConfig
+
+    rows = int(os.environ.get("SSB_ROWS", "6000000"))
+    paths, dims = B._prepare_dataset(rows, 0)
+
+    variants = {
+        "dense_kb1024_rb1024": dict(pallas_k_per_block=1024,
+                                    pallas_rows_per_block=1024),
+        "dense_kb512_rb1024": dict(pallas_k_per_block=512,
+                                   pallas_rows_per_block=1024),
+        "dense_kb2048_rb1024": dict(pallas_k_per_block=2048,
+                                    pallas_rows_per_block=1024),
+        "dense_kb1024_rb512": dict(pallas_k_per_block=1024,
+                                   pallas_rows_per_block=512),
+        "dense_kb1024_rb2048": dict(pallas_k_per_block=1024,
+                                    pallas_rows_per_block=2048),
+        # group cap below q2.2's K forces the sparse sort-based path
+        "sparse": dict(pallas_group_cap=64),
+    }
+    out = {"backend": jax.default_backend(), "rows": rows,
+           "iters": ITERS, "variants": {}}
+    baseline = None
+    for name, kw in variants.items():
+        eng = Engine(EngineConfig(use_pallas="auto", **kw))
+        register_ssb_parquet(eng, paths, dims)
+        rec = {}
+        try:
+            for q in QUERIES:
+                sql = SSB[q]
+                eng.sql(sql)  # warm/compile
+                times = []
+                for _ in range(ITERS):
+                    t0 = time.perf_counter()
+                    res = eng.sql(sql)
+                    times.append((time.perf_counter() - t0) * 1e3)
+                digest = len(res)
+                if baseline is None:
+                    pass
+                times.sort()
+                rec[q] = {"p50_ms": round(times[len(times) // 2], 3),
+                          "min_ms": round(times[0], 3),
+                          "groups": digest}
+        except Exception as err:  # noqa: BLE001 — a variant that fails
+            rec["error"] = f"{type(err).__name__}: {err}"[:500]
+        out["variants"][name] = rec
+        eng.clear_cache()
+        print(f"[sweep] {name}: "
+              f"{ {q: v.get('p50_ms') for q, v in rec.items() if isinstance(v, dict)} }",
+              file=sys.stderr, flush=True)
+    # cross-variant result sanity: group counts must agree everywhere
+    counts = {}
+    for name, rec in out["variants"].items():
+        for q, v in rec.items():
+            if isinstance(v, dict):
+                counts.setdefault(q, set()).add(v["groups"])
+    out["result_consistent"] = all(len(s) == 1 for s in counts.values())
+    with open(os.path.join(REPO, "PALLAS_SWEEP_TPU.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"result_consistent": out["result_consistent"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -172,6 +172,14 @@ EXTRA_LEGS = [
                          {"CAL_REQUIRE_TPU": "1"})),
     ("sf10 bench", _file_done("BENCH_TPU_SF10.json"),
      _bench_leg("BENCH_TPU_SF10.json", rows=60_000_000)),
+    # round-4 addition after the first window's findings: tiling/sparse
+    # sweep for the grouped outliers. (The per-query profile leg above
+    # re-banks PROFILE_TPU.json automatically under the corrected
+    # two-regime tuning — the first capture, renamed
+    # PROFILE_TPU_SCATTER.json, caught every grouped query on the
+    # scatter path because the inverted first fit routed them there.)
+    ("pallas tiling sweep", _file_done("PALLAS_SWEEP_TPU.json"),
+     lambda: attempt_cmd(["tools/sweep_pallas_tpu.py"])),
 ]
 MAX_LEG_FAILURES = 2  # deterministic failures must not eat the window
 

@@ -800,6 +800,12 @@ def _aggregate(df, exprs, out_names, group_exprs, stmt, time_col):
                     return vals[0].dropna().nunique()
                 tup = pd.concat(vals, axis=1).dropna()
                 return len(tup.drop_duplicates())
+            if e.name in ("sum_distinct", "avg_distinct"):
+                v = _eval_agg_input(e.args[0], sub, time_col) \
+                    .dropna().drop_duplicates()
+                if e.name == "sum_distinct":
+                    return v.sum() if len(v) else np.nan
+                return v.sum() / len(v) if len(v) else np.nan
             v = _eval_agg_input(e.args[0], sub, time_col)
             if e.name == "sum":
                 return v.sum()

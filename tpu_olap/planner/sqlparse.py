@@ -24,6 +24,7 @@ from tpu_olap.ir.expr import (BinOp, Col, Expr, FuncCall, Lit,
                               Subquery, WindowCall)
 
 AGG_FUNCS = {"count", "sum", "min", "max", "avg", "count_distinct",
+             "sum_distinct", "avg_distinct",
              "approx_count_distinct", "theta_sketch",
              # agg(...) FILTER (WHERE cond) wrapper node
              "agg_filter"}
@@ -587,9 +588,18 @@ class _Parser:
                         args.append(self.expr())
                 self.take("op", ")")
                 if distinct:
-                    if fname != "count":
-                        raise SqlError("DISTINCT only inside COUNT()")
-                    fname = "count_distinct"
+                    if fname == "count":
+                        fname = "count_distinct"
+                    elif fname in ("sum", "avg"):
+                        # fallback-path aggregates (the device planner
+                        # declines them legibly; the reference served
+                        # them via full Spark SQL, SURVEY.md §3.1)
+                        fname += "_distinct"
+                    elif fname in ("min", "max"):
+                        pass  # DISTINCT is a no-op for min/max
+                    else:
+                        raise SqlError(
+                            "DISTINCT only inside COUNT/SUM/AVG/MIN/MAX")
                 k2, v2 = self.peek()
                 if k2 == "name" and v2.lower() == "over":
                     return self._window(fname, tuple(args))
