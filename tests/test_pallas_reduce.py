@@ -321,3 +321,42 @@ def test_pallas_large_value_sums():
         phys = lower(plan.query, plan.entry.segments, forced.config)
         assert phys.pallas_reason is None, phys.pallas_reason
         pd.testing.assert_frame_equal(a, b)
+
+
+def test_pallas_factorized_boundary_sweep():
+    """The factorized lane packing (Factorization: key -> (k1, k2v), k2
+    groups per lane tile) must be value-identical to the direct one-hot
+    across group counts spanning the direct/factorized decision boundary
+    and the K % k2 != 0 tail-slice cases — including biased (negative)
+    sums, filtered aggs, and NULL inputs."""
+    from tpu_olap.kernels.pallas_reduce import factorization
+
+    rng = np.random.default_rng(23)
+    n = 4096
+    for card in (2, 9, 16, 63, 200, 1001):
+        df = pd.DataFrame({
+            "ts": pd.to_datetime("2022-01-01")
+            + pd.to_timedelta(rng.integers(0, 86400 * 10, n), unit="s"),
+            "g": rng.integers(0, card, n).astype(np.int64),
+            "v": rng.integers(-500, 500, n).astype(np.int64),
+        })
+        df.loc[rng.random(n) < 0.03, "v"] = np.nan
+        df["v"] = df["v"].astype("Int64")
+        plain = Engine(EngineConfig(use_pallas="never"))
+        forced = Engine(EngineConfig(use_pallas="force"))
+        for e in (plain, forced):
+            e.register_table("f_t", df, time_column="ts", block_rows=512)
+        q = ("SELECT g, sum(v) AS s, count(*) AS n, "
+             "sum(v) FILTER (WHERE v > 0) AS sp "
+             "FROM f_t GROUP BY g ORDER BY g")
+        a = plain.sql(q)
+        b = forced.sql(q)
+        assert forced.last_plan.rewritten
+        plan = forced.planner.plan(q)
+        phys = lower(plan.query, plan.entry.segments, forced.config)
+        assert phys.pallas_reason is None, phys.pallas_reason
+        pd.testing.assert_frame_equal(a, b)
+    # sanity: the sweep covered both layouts
+    cfg = EngineConfig()
+    assert factorization(2, 9, 0, cfg) is None
+    assert factorization(1001, 9, 0, cfg) is not None

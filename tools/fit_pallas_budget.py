@@ -63,6 +63,7 @@ def main():
     from tpu_olap import Engine
     from tpu_olap.bench import QUERIES, register_ssb_parquet
     from tpu_olap.executor.lowering import lower
+    from tpu_olap.kernels.pallas_reduce import tile_product
 
     # lower each query at a small scale to read K (scale-free) and
     # compute the FLOP product at the A/B scale
@@ -76,10 +77,11 @@ def main():
     for qname, sql in QUERIES.items():
         plan = eng.planner.plan(sql)
         phys = lower(plan.query, plan.entry.segments, eng.config)
-        kb = max(1, min(phys.total_groups, eng.config.pallas_k_per_block))
-        k_pad = -(-phys.total_groups // kb) * kb
         n_pad = -(-n_rows // block) * block
-        flops[qname] = 2.0 * k_pad * n_pad * 128
+        # same units as lowering's budget gate: factorization-aware
+        # tile product (kernels.pallas_reduce.tile_product)
+        flops[qname] = 2.0 * n_pad * tile_product(
+            phys, plan.entry.segments, eng.config)
         groups[qname] = phys.total_groups
 
     auto = runs["auto"]["detail"]["per_query_p50_ms"]

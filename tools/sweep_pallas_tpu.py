@@ -1,13 +1,15 @@
 """On-chip tiling/path sweep for the grouped SSB outliers (round 4).
 
-q2.2 (K=8008) costs ~240 ms warm at SF1 — ~173 ms compute over the
-67.5 ms tunnel RTT floor, ~37% MXU efficiency on the one-hot reduce
-(docs/PERF_MODEL.md). This sweeps the knobs that could close the gap,
-on real hardware, for the three worst grouped queries:
+Hardware A/B of every grouped-reduce execution path for the three
+worst grouped SSB queries (q2.2 K=8008, q4.3, q3.2):
 
-- pallas_k_per_block x pallas_rows_per_block tile shapes (MXU feed);
-- the sparse sort-based path (pallas_group_cap below K forces it) —
-  never benchmarked on hardware against the dense one-hot.
+- the factorized-lane-packing Pallas kernel across rows_per_block
+  tile shapes (pallas_k_per_block no longer distinguishes kernels at
+  these K — the factorized k1 axis fits one block);
+- the XLA scatter kernel (use_pallas="never", dense path);
+- the sparse sort-based path (dense_group_budget below each query's
+  restricted K; asserted via phys.sparse so a dense run can never
+  bank under the sparse label).
 
 Writes PALLAS_SWEEP_TPU.json; exits 3 on CPU (never banked as hardware
 evidence). Dataset comes from bench.py's cached SF1 parquet.
@@ -41,30 +43,43 @@ def main():
     rows = int(os.environ.get("SSB_ROWS", "6000000"))
     paths, dims = B._prepare_dataset(rows, 0)
 
+    # each variant is a DISTINCT compiled path (under the factorized
+    # lane packing, pallas_k_per_block no longer changes the kernel for
+    # these K values — the k1 axis fits one block); pallas variants use
+    # "force" and assert pallas_reason so a silently-declined plan can
+    # never bank as kernel evidence
     variants = {
-        "dense_kb1024_rb1024": dict(pallas_k_per_block=1024,
-                                    pallas_rows_per_block=1024),
-        "dense_kb512_rb1024": dict(pallas_k_per_block=512,
-                                   pallas_rows_per_block=1024),
-        "dense_kb2048_rb1024": dict(pallas_k_per_block=2048,
-                                    pallas_rows_per_block=1024),
-        "dense_kb1024_rb512": dict(pallas_k_per_block=1024,
-                                   pallas_rows_per_block=512),
-        "dense_kb1024_rb2048": dict(pallas_k_per_block=1024,
-                                    pallas_rows_per_block=2048),
-        # group cap below q2.2's K forces the sparse sort-based path
-        "sparse": dict(pallas_group_cap=64),
+        "pallas_rb1024": dict(use_pallas="force"),
+        "pallas_rb512": dict(use_pallas="force",
+                             pallas_rows_per_block=512),
+        "pallas_rb2048": dict(use_pallas="force",
+                              pallas_rows_per_block=2048),
+        # XLA scatter kernel (the pallas-declined dense path)
+        "scatter": dict(use_pallas="never"),
+        # dense budget below EVERY swept query's restricted K (q3.2:
+        # 400, q4.3: 1640, q2.2: 8008) forces the sort-based path for
+        # all three; asserted per query below
+        "sparse": dict(use_pallas="never", dense_group_budget=256),
     }
     out = {"backend": jax.default_backend(), "rows": rows,
            "iters": ITERS, "variants": {}}
-    baseline = None
+    from tpu_olap.executor.lowering import lower
     for name, kw in variants.items():
-        eng = Engine(EngineConfig(use_pallas="auto", **kw))
+        eng = Engine(EngineConfig(**kw))
         register_ssb_parquet(eng, paths, dims)
         rec = {}
         try:
             for q in QUERIES:
                 sql = SSB[q]
+                if kw.get("use_pallas") == "force" or name == "sparse":
+                    plan = eng.planner.plan(sql)
+                    phys = lower(plan.query, plan.entry.segments,
+                                 eng.config)
+                    if name == "sparse":
+                        assert phys.sparse, f"{name}/{q}: not sparse"
+                    else:
+                        assert phys.pallas_reason is None, (
+                            f"{name}/{q}: {phys.pallas_reason}")
                 eng.sql(sql)  # warm/compile
                 times = []
                 for _ in range(ITERS):
@@ -72,8 +87,6 @@ def main():
                     res = eng.sql(sql)
                     times.append((time.perf_counter() - t0) * 1e3)
                 digest = len(res)
-                if baseline is None:
-                    pass
                 times.sort()
                 rec[q] = {"p50_ms": round(times[len(times) // 2], 3),
                           "min_ms": round(times[0], 3),

@@ -445,13 +445,12 @@ def _maybe_use_pallas(plan, query, table, config, filter_fn, imask_fn=None):
     if budget is None:
         budget = tuning.get("auto_flop_budget")
     if config.use_pallas == "auto" and budget is not None:
-        # the one-hot reduce is O(K·n): K_pad*n*H_pad*2 FLOPs
+        # the one-hot reduce is O(K·n): 2 * n * tile_product FLOPs, where
+        # the tile product accounts for the factorized lane packing
         # (docs/PERF_MODEL.md). Past the budget the XLA scatter kernel
         # wins — its work is n-bound and K-free.
         n = len(table.segments) * table.block_rows
-        kb = max(1, min(plan.total_groups, config.pallas_k_per_block))
-        k_pad = -(-plan.total_groups // kb) * kb
-        flops = 2.0 * k_pad * n * 128
+        flops = 2.0 * n * pallas_reduce.tile_product(plan, table, config)
         if flops > budget:
             plan.pallas_reason = (
                 f"auto: one-hot reduce needs {flops:.2e} FLOPs for "

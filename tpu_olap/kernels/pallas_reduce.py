@@ -295,6 +295,72 @@ def _filter_ok(spec) -> bool:
 
 
 @dataclass
+class Factorization:
+    """Large-K lane packing: the dense key splits into (key >> s,
+    key & (k2 - 1)) and k2 groups' aggregate columns share one lane tile,
+    so the MXU tile product tracks K*H instead of K*128 (the direct
+    layout pads H to a full 128-lane tile — a ~12x FLOP waste at H ~ 10).
+    k2 is a power of two >= 8 so every sublane concat stays 8-aligned
+    (Mosaic relayouts on misaligned sublane offsets are the alternative).
+    Output entry (k1, h*k2 + k2v) holds agg column h of group k1*k2+k2v."""
+    k2: int        # groups packed per lane tile (power of two, >= 8)
+    shift: int     # log2(k2)
+    width: int     # lane-padded k2 * H
+    k1_pad: int    # padded row count of the [k1, width] output
+    kb: int        # K1 rows per grid block
+    n_kb: int      # grid blocks over the k1 axis
+
+
+def factorization(K, H, n_mm, config) -> Factorization | None:
+    """Pick the lane packing minimizing the output tile product, or None
+    when the direct layout is no worse (small K) or inapplicable: min/max
+    aggs key their VPU buffer on the full K (n_mm > 0), and H > 32 would
+    spill past two lane tiles per group batch."""
+    if n_mm or K < 2 or H > 32:
+        return None
+    kb_d = min(K, config.pallas_k_per_block)
+    direct = -(-K // kb_d) * kb_d * max(128, -(-H // 128) * 128)
+    best = None
+    for k2 in (8, 16, 32, 64):
+        width = -(-k2 * H // 128) * 128
+        k1 = -(-K // k2)
+        kb = min(-(-k1 // 8) * 8, config.pallas_k_per_block)
+        n_kb = -(-k1 // kb)
+        k1_pad = n_kb * kb
+        prod = k1_pad * width
+        # tie -> larger k2: fewer k1 rows means fewer passes over the
+        # row stream once K1 exceeds one grid block
+        if best is None or prod <= best[0]:
+            best = (prod, Factorization(k2, k2.bit_length() - 1, width,
+                                        k1_pad, kb, n_kb))
+    return best[1] if best and best[0] < direct else None
+
+
+def _layout_for(plan, table) -> "PallasLayout":
+    """plan_layout memoized on the plan (same pattern as
+    traced_const_names): eligible(), the FLOP budget gate, and
+    build_kernel all need the identical layout during one lowering."""
+    cached = getattr(plan, "_pallas_layout", None)
+    if cached is None:
+        cached = plan._pallas_layout = plan_layout(
+            plan.agg_plans, sum_bounds(plan, table))
+    return cached
+
+
+def tile_product(plan, table, config) -> int:
+    """K_pad * lane_width of the accumulator the kernel would build —
+    the one-hot reduce costs 2 * n_rows * tile_product FLOPs. Shared by
+    build_kernel and the auto-policy FLOP budget gate in lowering."""
+    layout = _layout_for(plan, table)
+    K = plan.total_groups
+    fact = factorization(K, layout.n_cols, layout.n_minmax, config)
+    if fact is not None:
+        return fact.k1_pad * fact.width
+    kb = min(K, config.pallas_k_per_block)
+    return -(-K // kb) * kb * max(128, -(-layout.n_cols // 128) * 128)
+
+
+@dataclass
 class PallasLayout:
     """Half-plane column layout of the [K, H] accumulator."""
     n_cols: int                   # H (before lane padding)
@@ -424,7 +490,7 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    layout = plan_layout(plan.agg_plans, sum_bounds(plan, table))
+    layout = _layout_for(plan, table)
     K = plan.total_groups
     H = layout.n_cols
     H_pad = max(128, -(-H // 128) * 128)
@@ -438,9 +504,15 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
     n_pre = (1 if has_buckets else 0) + sum(pre_dims)
     block_rows = table.block_rows
     rb = min(block_rows, config.pallas_rows_per_block)
-    KB = min(K, config.pallas_k_per_block)
-    n_kb = -(-K // KB)
-    K_pad = n_kb * KB
+    fact = factorization(K, H, layout.n_minmax, config)
+    if fact is not None:
+        KB, n_kb, K_pad = fact.kb, fact.n_kb, fact.k1_pad
+        W = fact.width
+    else:
+        KB = min(K, config.pallas_k_per_block)
+        n_kb = -(-K // KB)
+        K_pad = n_kb * KB
+        W = H_pad
 
     const_names = traced_const_names(plan, table, filter_fn)
     col_names = [c for c in kernel_columns(plan) if c != TIME_COLUMN] \
@@ -498,9 +570,18 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
                 key = jnp.zeros((rb,), jnp.int32)
 
             # transposed masked one-hot [KB, rb] for this K-block — built
-            # directly in K-major orientation so every op stays 2-D
+            # directly in K-major orientation so every op stays 2-D. Under
+            # factorization the row axis indexes k1 = key >> s; garbage
+            # keys on masked-out rows shift to negative k1 and never match
             kk = jax.lax.broadcasted_iota(jnp.int32, (KB, rb), 0) + kb * KB
-            onehot = ((kk == key[None, :]) & mask[None, :]).astype(jnp.bfloat16)
+            if fact is not None:
+                k1 = jnp.right_shift(key, jnp.int32(fact.shift))
+                k2v = jnp.bitwise_and(key, jnp.int32(fact.k2 - 1))
+                onehot = ((kk == k1[None, :])
+                          & mask[None, :]).astype(jnp.bfloat16)
+            else:
+                onehot = ((kk == key[None, :])
+                          & mask[None, :]).astype(jnp.bfloat16)
 
             # value planes [H_pad, rb]
             rows = [mask.astype(jnp.bfloat16)[None, :]]
@@ -540,10 +621,23 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
                     rows.append(h.astype(jnp.bfloat16)[None, :])
                 if bias:  # per-agg masked row count for the un-shift
                     rows.append(m.astype(jnp.bfloat16)[None, :])
-            pad = H_pad - len(rows)
-            if pad:
-                rows.append(jnp.zeros((pad, rb), jnp.bfloat16))
-            vals = jnp.concatenate(rows, axis=0)
+            if fact is not None:
+                # pack k2 groups per lane tile: each [1, rb] agg row h
+                # expands through onehot2 into rows [h*k2, (h+1)*k2) —
+                # h-major so every concat part is k2 (>= 8) sublanes
+                oh2 = (jax.lax.broadcasted_iota(
+                    jnp.int32, (fact.k2, rb), 0)
+                    == k2v[None, :]).astype(jnp.bfloat16)
+                parts = [oh2 * r for r in rows]
+                pad = W - fact.k2 * len(rows)
+                if pad:
+                    parts.append(jnp.zeros((pad, rb), jnp.bfloat16))
+                vals = jnp.concatenate(parts, axis=0)
+            else:
+                pad = H_pad - len(rows)
+                if pad:
+                    rows.append(jnp.zeros((pad, rb), jnp.bfloat16))
+                vals = jnp.concatenate(rows, axis=0)
 
             partial = jax.lax.dot_general(
                 onehot, vals, (((1,), (1,)), ((), ())),
@@ -551,7 +645,7 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
 
             @pl.when(step == 0)
             def _():
-                out_ref[:, :] = jnp.zeros((KB, H_pad), jnp.int32)
+                out_ref[:, :] = jnp.zeros((KB, W), jnp.int32)
             out_ref[:, :] += partial
 
             if mm_ref is not None:
@@ -615,8 +709,8 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
         const_in = [_narrow(jnp.asarray(consts[c]).reshape(1, -1), jnp)
                     for c in const_names]
 
-        out_specs = pl.BlockSpec((KB, H_pad), lambda kb, i: (kb, _z))
-        out_shape = jax.ShapeDtypeStruct((K_pad, H_pad), jnp.int32)
+        out_specs = pl.BlockSpec((KB, W), lambda kb, i: (kb, _z))
+        out_shape = jax.ShapeDtypeStruct((K_pad, W), jnp.int32)
         if n_mm:
             out_specs = [out_specs,
                          pl.BlockSpec((KB, MM_pad), lambda kb, i: (kb, _z))]
@@ -638,6 +732,13 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
         if n_mm:
             out, mm = out
             mm = mm[:K]
+        if fact is not None:
+            # entry (k1, h*k2 + k2v) -> row k1*k2 + k2v == dense key,
+            # column h: plain XLA reshuffle outside the pallas_call
+            out = (out[:, :fact.k2 * H]
+                   .reshape(K_pad, H, fact.k2)
+                   .transpose(0, 2, 1)
+                   .reshape(K_pad * fact.k2, H))
         out = out[:K]
 
         res = {"_rows": out[:, layout.rows_slot].astype(jnp.int64)}

@@ -1111,8 +1111,13 @@ def _chunked_aggregate(stmt, chunks, exprs, out_names, group_exprs,
             return e.args[0], e.args[1]
         return e, None
 
-    distinct_keys = [k for k, e in specs if _unwrap(e)[0].name in (
-        "count_distinct", "approx_count_distinct", "theta_sketch")]
+    # every aggregate needing the full per-group distinct value set rides
+    # the same deduped (group, value)-pairs accumulation across chunks
+    distinct_specs = {k: _unwrap(e)[0].name for k, e in specs
+                      if _unwrap(e)[0].name in (
+                          "count_distinct", "approx_count_distinct",
+                          "theta_sketch", "sum_distinct", "avg_distinct")}
+    distinct_keys = list(distinct_specs)
 
     def chunk_partial(df):
         """One chunk -> (partials frame, {agg key: distinct-pairs frame})."""
@@ -1128,7 +1133,7 @@ def _chunked_aggregate(stmt, chunks, exprs, out_names, group_exprs,
                 mask = pd.Series(_eval(cond, df, time_col),
                                  index=df.index).fillna(False).astype(bool)
             if e.name in ("count_distinct", "approx_count_distinct",
-                          "theta_sketch"):
+                          "theta_sketch", "sum_distinct", "avg_distinct"):
                 if e.name == "theta_sketch" and len(e.args) != 1:
                     raise FallbackError("theta_sketch takes one column")
                 sub = df if mask is None else df[mask]
@@ -1191,15 +1196,20 @@ def _chunked_aggregate(stmt, chunks, exprs, out_names, group_exprs,
                                            ignore_index=True)
                                  .drop_duplicates()]
             if pair_parts[k] and len(pair_parts[k][0]) > pair_cap:
-                # COUNT(DISTINCT high-cardinality) needs the full value
-                # set; refusing with a clear error beats an OOM (the
-                # "never an error" property is already forfeit either
-                # way — this makes the failure legible and bounded)
-                raise FallbackError(
-                    "chunked fallback COUNT(DISTINCT) exceeds "
-                    f"fallback_scan_row_cap={pair_cap} distinct pairs; "
+                # a high-cardinality DISTINCT aggregate needs the full
+                # value set; refusing with a clear error beats an OOM
+                # (the "never an error" property is already forfeit
+                # either way — this makes the failure legible/bounded)
+                name = distinct_specs[k]
+                remedy = (
                     "use approx_count_distinct on the device path or "
-                    "raise the cap")
+                    "raise the cap"
+                    if name in ("count_distinct", "approx_count_distinct",
+                                "theta_sketch") else "raise the cap")
+                raise FallbackError(
+                    f"chunked fallback {name} exceeds "
+                    f"fallback_scan_row_cap={pair_cap} distinct pairs; "
+                    f"{remedy}")
 
     pending_rows = 0
     empty_proto = None   # 0-row joined frame with the real schema
@@ -1242,11 +1252,25 @@ def _chunked_aggregate(stmt, chunks, exprs, out_names, group_exprs,
         return tuple(_FILL if (not isinstance(v, str) and pd.isna(v))
                      else v for v in t)
 
-    # distinct counts per group: {agg key: {group tuple: count}}
+    # distinct counts per group: {agg key: {group tuple: count}};
+    # sum/avg over distinct values: {agg key: {group tuple: (sum, n)}}
     dcounts: dict = {}
+    dstats: dict = {}
     for k in distinct_keys:
         pairs = pair_parts[k][0] if pair_parts[k] else \
-            pd.DataFrame(columns=gcols)
+            pd.DataFrame(columns=gcols + ["v0"])
+        if distinct_specs[k] in ("sum_distinct", "avg_distinct"):
+            if gcols:
+                grp = pairs.groupby(gcols, sort=False, dropna=False)["v0"]
+                sizes = grp.size()
+                dstats[k] = {
+                    _norm_key(kk if isinstance(kk, tuple) else (kk,)):
+                        (sv, int(nv))
+                    for (kk, sv), nv in zip(grp.sum().items(), sizes)}
+            else:
+                v = pairs["v0"]
+                dstats[k] = {(): (v.sum() if len(v) else np.nan, len(v))}
+            continue
         if gcols:
             sizes = pairs.groupby(gcols, sort=False, dropna=False).size()
             dcounts[k] = {_norm_key(kk if isinstance(kk, tuple)
@@ -1344,6 +1368,11 @@ def _chunked_aggregate(stmt, chunks, exprs, out_names, group_exprs,
         if inner.name in ("count_distinct", "approx_count_distinct",
                           "theta_sketch"):
             return dcounts[k].get(_norm_key(gkey), 0)
+        if inner.name in ("sum_distinct", "avg_distinct"):
+            s, c = dstats[k].get(_norm_key(gkey), (np.nan, 0))
+            if not c:
+                return np.nan
+            return s if inner.name == "sum_distinct" else s / c
         if inner.name == "count" and not inner.args:
             return int(row[spec_col[k]] if cond is not None
                        else row["__rows"])
@@ -1396,21 +1425,30 @@ def _chunked_aggregate(stmt, chunks, exprs, out_names, group_exprs,
             order_exprs[col] = item.expr
         ascending.append(not item.descending)
 
-    def _vec_count_lookup(d: dict) -> pd.Series:
-        """{group tuple: count} -> Series aligned to merged's rows:
+    def _vec_count_lookup(d: dict, fill=0, dtype="int64") -> pd.Series:
+        """{group tuple: value} -> Series aligned to merged's rows:
         normalize NaN group-key slots to the string fill exactly like
-        _norm_key, then reindex."""
+        _norm_key, then reindex. fill/dtype support the float-valued
+        sum_distinct lookups (absent group -> NaN)."""
         if not gcols:
-            return pd.Series([d.get((), 0)] * len(merged),
+            return pd.Series([d.get((), fill)] * len(merged),
                              index=merged.index)
         mi = pd.MultiIndex.from_frame(
             pd.DataFrame({c: _norm_gcol(merged[c]) for c in gcols}))
         if d:
-            lut = pd.Series(list(d.values()),
+            # dtype at construction: Int64 luts must not round-trip
+            # through the float64 promotion reindex would otherwise do
+            lut = pd.Series(list(d.values()), dtype=dtype,
                             index=pd.MultiIndex.from_tuples(d))
-            vals = lut.reindex(mi).fillna(0).astype("int64")
+            vals = lut.reindex(mi)
+            vals = vals.fillna(fill) if not pd.isna(fill) else vals
+            vals = vals.astype(dtype)
         else:
-            vals = pd.Series(0, index=mi)
+            vals = pd.Series(fill, index=mi, dtype=dtype)
+        if str(vals.dtype) == "Int64":
+            # keep the extension array: to_numpy() would degrade Int64
+            # to an object array of pd.NA-mixed Python ints
+            return pd.Series(vals.array, index=merged.index)
         return pd.Series(vals.to_numpy(), index=merged.index)
 
     def vec_merged(e) -> pd.Series:
@@ -1434,6 +1472,22 @@ def _chunked_aggregate(stmt, chunks, exprs, out_names, group_exprs,
             if inner.name in ("count_distinct", "approx_count_distinct",
                               "theta_sketch"):
                 return _vec_count_lookup(dcounts[k])
+            if inner.name in ("sum_distinct", "avg_distinct"):
+                vals = {g: v[0] for g, v in dstats[k].items()}
+                # integer sums stay exact via the nullable Int64 dtype
+                # (a float64 cast would round past 2^53, diverging from
+                # the whole-frame path); floats keep NaN semantics
+                int_exact = all(isinstance(x, (int, np.integer))
+                                for x in vals.values())
+                s = _vec_count_lookup(
+                    vals, fill=pd.NA if int_exact else np.nan,
+                    dtype="Int64" if int_exact else "float64")
+                if inner.name == "sum_distinct":
+                    return s
+                n = _vec_count_lookup(
+                    {g: v[1] for g, v in dstats[k].items()},
+                    fill=np.nan, dtype="float64")
+                return s.astype("float64") / n.where(n != 0, np.nan)
             if inner.name == "count" and not inner.args:
                 s = merged[spec_col[k]] if cond is not None \
                     else merged["__rows"]

@@ -594,9 +594,18 @@ class _Parser:
                         # fallback-path aggregates (the device planner
                         # declines them legibly; the reference served
                         # them via full Spark SQL, SURVEY.md §3.1)
+                        if len(args) != 1:
+                            raise SqlError(
+                                f"{fname}(DISTINCT ...) takes exactly "
+                                "one argument")
                         fname += "_distinct"
                     elif fname in ("min", "max"):
-                        pass  # DISTINCT is a no-op for min/max
+                        # DISTINCT is a no-op for min/max, but only the
+                        # single-argument form is well-defined
+                        if len(args) != 1:
+                            raise SqlError(
+                                f"{fname}(DISTINCT ...) takes exactly "
+                                "one argument")
                     else:
                         raise SqlError(
                             "DISTINCT only inside COUNT/SUM/AVG/MIN/MAX")
