@@ -460,3 +460,153 @@ def test_sum_avg_distinct(eng):
     assert int(g["sd"].iloc[0]) == int(fact.v.drop_duplicates().sum())
     with pytest.raises(Exception, match="DISTINCT"):
         e.sql("SELECT theta_sketch(DISTINCT v) FROM fact")
+
+
+def test_output_alias_in_group_and_order(eng):
+    """Output-alias references in GROUP BY / ORDER BY (Spark/MySQL
+    semantics): alias resolves unless it shadows a source column, and
+    the resolved form may take the device path."""
+    e, fact, _ = eng
+    got = e.sql("SELECT v % 10 AS b, count(*) AS n FROM fact "
+                "GROUP BY b ORDER BY b")
+    exp = (fact.assign(b=fact.v % 10).groupby("b").size()
+           .sort_index())
+    assert [int(x) for x in got["n"]] == [int(x) for x in exp]
+    # ORDER BY an expression over an alias
+    got2 = e.sql("SELECT grp, count(*) AS n FROM fact GROUP BY grp "
+                 "ORDER BY n % 7, grp")
+    exp2 = fact.groupby("grp").size().reset_index(name="n")
+    exp2 = exp2.sort_values(["n", "grp"],
+                            key=lambda s: s % 7 if s.name == "n" else s)
+    assert list(got2["grp"]) == list(exp2["grp"])
+    # a source column wins over a same-named alias
+    got3 = e.sql("SELECT sum(v) AS v, grp FROM fact GROUP BY grp "
+                 "ORDER BY grp")
+    assert [int(x) for x in got3["v"]] == \
+        [int(x) for x in fact.groupby("grp").v.sum().sort_index()]
+
+
+def test_tuple_in(eng):
+    e, fact, _ = eng
+    got = e.sql("SELECT count(*) AS n FROM fact "
+                "WHERE (grp, k) IN (('a', 3), ('b', 5))")
+    assert e.last_plan.rewritten
+    exp = (((fact.grp == "a") & (fact.k == 3))
+           | ((fact.grp == "b") & (fact.k == 5))).sum()
+    assert int(got["n"].iloc[0]) == int(exp)
+    with pytest.raises(Exception, match="arity"):
+        e.sql("SELECT count(*) FROM fact "
+              "WHERE (grp, k) IN (('a', 1, 2))")
+
+
+def test_timestamp_interval_literals(eng):
+    e, fact, _ = eng
+    got = e.sql("SELECT count(*) AS n FROM fact "
+                "WHERE ts >= TIMESTAMP '2024-02-01' - INTERVAL '7' DAY")
+    exp = (fact.ts >= pd.Timestamp("2024-01-25")).sum()
+    assert int(got["n"].iloc[0]) == int(exp)
+    got2 = e.sql("SELECT count(*) AS n FROM fact "
+                 "WHERE ts < DATE '2024-01-01' + INTERVAL 1 MONTH")
+    exp2 = (fact.ts < pd.Timestamp("2024-02-01")).sum()
+    assert int(got2["n"].iloc[0]) == int(exp2)
+
+
+def test_window_over_grouped_query(eng):
+    """Window functions evaluate AFTER grouping (rewritten to the
+    derived-table form): rank over per-group aggregates."""
+    e, fact, _ = eng
+    got = e.sql("SELECT grp, k, rank() OVER (PARTITION BY grp "
+                "ORDER BY sum(v) DESC) AS r FROM fact "
+                "GROUP BY grp, k ORDER BY grp, r, k")
+    g = fact.groupby(["grp", "k"]).v.sum().reset_index()
+    g["r"] = g.groupby("grp").v.rank(method="min", ascending=False)
+    g = g.sort_values(["grp", "r", "k"])
+    assert [int(x) for x in got["r"]] == [int(x) for x in g["r"]]
+    # running total over the grouped rows
+    got2 = e.sql("SELECT grp, sum(v) AS s, sum(sum(v)) OVER "
+                 "(ORDER BY grp) AS rt FROM fact GROUP BY grp "
+                 "ORDER BY grp")
+    exp2 = fact.groupby("grp").v.sum().sort_index().cumsum()
+    assert [int(x) for x in got2["rt"]] == [int(x) for x in exp2]
+
+
+def test_rows_frame_windows(eng):
+    e, fact, _ = eng
+    df = pd.DataFrame({"ts": pd.to_datetime("2021-01-01")
+                       + pd.to_timedelta(range(8), unit="D"),
+                       "v": [3, 1, 4, 1, 5, 9, 2, 6]})
+    e.register_table("fr", df, time_column="ts")
+    got = e.sql("SELECT ts, sum(v) OVER (ORDER BY ts ROWS BETWEEN 2 "
+                "PRECEDING AND CURRENT ROW) AS rs, min(v) OVER (ORDER "
+                "BY ts ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING) AS mn "
+                "FROM fr ORDER BY ts")
+    exp_rs = df.v.rolling(3, min_periods=1).sum()
+    exp_mn = df.v.rolling(3, min_periods=1, center=True).min()
+    assert [float(x) for x in got["rs"]] == [float(x) for x in exp_rs]
+    assert [float(x) for x in got["mn"]] == [float(x) for x in exp_mn]
+    with pytest.raises(Exception, match="RANGE"):
+        e.sql("SELECT sum(v) OVER (ORDER BY ts RANGE BETWEEN 1 "
+              "PRECEDING AND CURRENT ROW) FROM fr")
+
+
+def test_comparison_correlated_exists(eng):
+    """Non-equality correlated EXISTS via the per-group min/max
+    reduction: EXISTS(... inner OP outer AND eq-keys) <=> the group
+    extreme satisfies OP."""
+    e, fact, _ = eng
+    mx = fact.groupby("grp").v.transform("max")
+    got = e.sql(
+        "SELECT count(*) AS n FROM fact f1 WHERE EXISTS "
+        "(SELECT 1 FROM fact f2 WHERE f2.v > f1.v AND f2.grp = f1.grp)")
+    assert int(got["n"].iloc[0]) == int((fact.v < mx).sum())
+    got2 = e.sql(
+        "SELECT count(*) AS n FROM fact f1 WHERE NOT EXISTS "
+        "(SELECT 1 FROM fact f2 WHERE f2.v > f1.v AND f2.grp = f1.grp)")
+    assert int(got2["n"].iloc[0]) == int((fact.v == mx).sum())
+    # no equality key: global extreme
+    got3 = e.sql(
+        "SELECT count(*) AS n FROM fact f1 WHERE EXISTS "
+        "(SELECT 1 FROM fact f2 WHERE f2.v > f1.v)")
+    assert int(got3["n"].iloc[0]) == int((fact.v < fact.v.max()).sum())
+    # two comparison conjuncts cannot be witnessed by min/max: legible
+    with pytest.raises(Exception, match="one comparison"):
+        e.sql("SELECT count(*) FROM fact f1 WHERE EXISTS "
+              "(SELECT 1 FROM fact f2 WHERE f2.v > f1.v AND "
+              "f2.k < f1.k)")
+
+
+def test_window_over_groups_nested_scopes(eng):
+    """The grouped-window rewrite applies inside CTEs, derived tables,
+    and UNION parts, not just at top level."""
+    e, fact, _ = eng
+    top = e.sql("SELECT grp, rank() OVER (ORDER BY sum(v) DESC) AS r "
+                "FROM fact GROUP BY grp ORDER BY r, grp")
+    cte = e.sql("WITH x AS (SELECT grp, rank() OVER (ORDER BY sum(v) "
+                "DESC) AS r FROM fact GROUP BY grp) "
+                "SELECT * FROM x ORDER BY r, grp")
+    der = e.sql("SELECT * FROM (SELECT grp, rank() OVER (ORDER BY "
+                "sum(v) DESC) AS r FROM fact GROUP BY grp) d "
+                "ORDER BY r, grp")
+    assert list(cte["r"]) == list(top["r"])
+    assert list(der["r"]) == list(top["r"])
+    # unaliased projections keep human-readable headers
+    h = e.sql("SELECT grp, sum(v), rank() OVER (ORDER BY sum(v)) AS r "
+              "FROM fact GROUP BY grp")
+    assert list(h.columns) == ["grp", "sum(v)", "r"]
+
+
+def test_interval_commuted_and_rejections(eng):
+    e, fact, _ = eng
+    a = e.sql("SELECT count(*) AS n FROM fact "
+              "WHERE ts < TIMESTAMP '2024-02-01' + INTERVAL '1' DAY")
+    b = e.sql("SELECT count(*) AS n FROM fact "
+              "WHERE ts < INTERVAL '1' DAY + TIMESTAMP '2024-02-01'")
+    assert int(a["n"].iloc[0]) == int(b["n"].iloc[0])
+    with pytest.raises(Exception, match="INTERVAL"):
+        e.sql("SELECT INTERVAL '1' DAY FROM fact")
+    with pytest.raises(Exception, match="integer"):
+        e.sql("SELECT sum(v) OVER (ORDER BY ts ROWS 1.5 PRECEDING) "
+              "FROM fact")
+    with pytest.raises(Exception, match="frame"):
+        e.sql("SELECT sum(v) OVER (ORDER BY ts ROWS BETWEEN CURRENT "
+              "ROW AND UNBOUNDED PRECEDING) FROM fact")

@@ -90,29 +90,50 @@ def main():
     k1 = [q for q in QUERIES if groups[q] == 1]
     grouped = [q for q in QUERIES if groups[q] > 1]
 
+    # the fit is self-referential: the auto leg ran under the PRIOR
+    # tuned policy, so queries that policy routed to the generic kernel
+    # measured generic-vs-generic — uninformative for this fit and, left
+    # unguarded, noise would flip the policy back and forth between runs
+    prior = {}
+    tuning_path = os.path.join(REPO, "tpu_olap", "planner",
+                               "pallas_tuning.json")
+    if os.path.exists(tuning_path):
+        try:
+            with open(tuning_path) as f:
+                prior = json.load(f)
+        except Exception:  # noqa: BLE001 — a bad file just means no prior
+            prior = {}
+    prior_budget = prior.get("auto_flop_budget")
+
     # regime 1: ungrouped — a single yes/no, not a threshold
-    ungrouped_pallas = None
-    if k1:
+    ungrouped_pallas = prior.get("auto_ungrouped_pallas")
+    if k1 and ungrouped_pallas is not False:
         losing = [q for q in k1 if auto[q] > never[q] * NOISE]
         winning = [q for q in k1 if auto[q] * NOISE < never[q]]
         if losing and not winning:
             ungrouped_pallas = False
         elif winning and not losing:
             ungrouped_pallas = True
-        # mixed/noise-bound: leave None (keep the kernel; it is within
-        # the noise margin either way)
+        # mixed/noise-bound: keep the prior (within noise either way)
 
     # regime 2: grouped — upper FLOP cap, only where losses sit above
-    # every win (the O(K·n) asymptote)
-    wins = [flops[q] for q in grouped if auto[q] * NOISE < never[q]]
-    losses = [flops[q] for q in grouped if auto[q] > never[q] * NOISE]
+    # every win (the O(K·n) asymptote); queries the prior budget already
+    # declined measured the generic kernel, not pallas — exclude them
+    informative = [q for q in grouped
+                   if prior_budget is None or flops[q] <= prior_budget]
+    wins = [flops[q] for q in informative if auto[q] * NOISE < never[q]]
+    losses = [flops[q] for q in informative if auto[q] > never[q] * NOISE]
     lo = max(wins) if wins else None       # keep pallas at least here
     hi = min([f for f in losses if lo is None or f > lo] or [None]) \
         if losses else None
 
     if hi is None:
-        budget = None
-        verdict = ("no grouped loss observed: no cap"
+        # no informative loss: a prior cap stays (runs under it cannot
+        # prove queries above it are safe), absent cap stays absent
+        budget = prior_budget
+        verdict = ("no grouped loss observed: "
+                   + ("prior cap kept" if prior_budget is not None
+                      else "no cap")
                    if not losses else
                    "grouped losses all below wins: noise, no cap")
     elif lo is None:

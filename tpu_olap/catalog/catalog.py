@@ -76,6 +76,40 @@ class TableEntry:
         None — monitoring must never force a lazy parquet load."""
         return len(self._frame) if self._frame is not None else None
 
+    def column_names(self) -> set:
+        """Visible SQL column names, computed WITHOUT materializing a
+        lazy parquet frame (segments schema, an already-loaded frame, or
+        the parquet footer). Used by output-alias resolution to decide
+        whether a bare name in GROUP BY / ORDER BY shadows a column.
+        Cached: entries are immutable after registration, and the
+        parquet-footer read must not sit on the per-query plan path."""
+        cached = getattr(self, "_column_names", None)
+        if cached is not None:
+            return cached
+        cols: set = set()
+        if self.segments is not None:
+            cols.update(self.segments.schema)
+        elif self._frame is not None:
+            cols.update(self._frame.columns)
+        elif self.parquet_paths:
+            import pyarrow.parquet as pq
+            pf = pq.ParquetFile(self.parquet_paths[0])
+            try:
+                names = pf.schema_arrow.names
+            finally:
+                pf.close()
+            cmap = self.parquet_column_map or {}
+            cols.update(cmap.get(n, n) for n in names)
+        elif self.frame_source is not None \
+                and not callable(self.frame_source):
+            cols.update(self.frame_source.columns)
+        else:
+            cols.update(self.frame.columns)  # small dimension tables
+        if self.time_column:
+            cols.add(self.time_column)
+        self._column_names = cols
+        return cols
+
     @property
     def is_accelerated(self) -> bool:
         return self.segments is not None

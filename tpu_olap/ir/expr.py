@@ -117,6 +117,11 @@ class WindowCall(Expr):
     args: tuple
     partition_by: tuple = ()
     order_by: tuple = ()       # ((expr, descending), ...)
+    # ROWS BETWEEN frame as (lo, hi) row offsets relative to the current
+    # row (negative = preceding); None in a slot = UNBOUNDED on that
+    # side. None overall = the standard default (running aggregate with
+    # ORDER BY, whole partition without)
+    frame: tuple | None = None
 
     def columns(self):
         out = set()
@@ -149,6 +154,31 @@ class Subquery(Expr):
     def to_json(self):
         # structural identity only (expr_key); never sent to a device
         return {"type": "subquery", "stmt": repr(self.stmt)}
+
+
+def map_expr(e, fn):
+    """Shared expression-rebuild walker: apply `fn` to each node
+    top-down; a non-None return REPLACES the node (children are not
+    visited — whole-subtree substitutions match first), None means
+    rebuild the node from its mapped children. Subquery internals are an
+    inner scope and are never descended into. Every rebuilding traversal
+    (alias substitution, windows-over-groups rewrite, lookup inlining)
+    rides this one walker so a future Expr field is threaded in exactly
+    one place."""
+    r = fn(e)
+    if r is not None:
+        return r
+    if isinstance(e, BinOp):
+        return BinOp(e.op, map_expr(e.left, fn), map_expr(e.right, fn))
+    if isinstance(e, WindowCall):
+        return WindowCall(
+            e.name, tuple(map_expr(a, fn) for a in e.args),
+            tuple(map_expr(p, fn) for p in e.partition_by),
+            tuple((map_expr(x, fn), d) for x, d in e.order_by),
+            e.frame)
+    if isinstance(e, FuncCall):
+        return FuncCall(e.name, tuple(map_expr(a, fn) for a in e.args))
+    return e  # Col, Lit, Subquery
 
 
 # ---------------------------------------------------------------------------

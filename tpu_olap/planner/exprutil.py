@@ -29,6 +29,19 @@ def contains_agg(e) -> bool:
     return False
 
 
+def contains_window(e) -> bool:
+    """Shared window-presence predicate (the planner's grouped-window
+    rewrite and the chunked-fallback guard must agree on it)."""
+    from tpu_olap.ir.expr import WindowCall
+    if isinstance(e, WindowCall):
+        return True
+    if isinstance(e, BinOp):
+        return contains_window(e.left) or contains_window(e.right)
+    if isinstance(e, FuncCall):
+        return any(contains_window(a) for a in e.args)
+    return False
+
+
 def expr_key(e) -> str:
     """Structural identity for dedup/alias maps."""
     return json.dumps(e.to_json(), sort_keys=True) \

@@ -290,9 +290,15 @@ def _resolve_subqueries(stmt: SelectStmt, catalog, config,
         else set()
 
     def walk(e):
-        nonlocal hit
-        if e is None or isinstance(e, (Lit, Col)):
+        if e is None:
             return e
+        from tpu_olap.ir.expr import map_expr
+        return map_expr(e, special)
+
+    def special(e):
+        """Subquery-bearing nodes resolve to replacements; None lets
+        the shared walker rebuild from mapped children."""
+        nonlocal hit
         if isinstance(e, FuncCall) and e.name == "exists":
             # EXISTS (SELECT ...): true iff the subquery returns any row
             # — one row is enough, so cap it
@@ -342,16 +348,7 @@ def _resolve_subqueries(stmt: SelectStmt, catalog, config,
             return FuncCall("lookup_map",
                             (walk(e.args[0]),
                              Lit(tuple(sorted(mapping.items())))))
-        if isinstance(e, BinOp):
-            return BinOp(e.op, walk(e.left), walk(e.right))
-        if isinstance(e, WindowCall):
-            return WindowCall(
-                e.name, tuple(walk(a) for a in e.args),
-                tuple(walk(p) for p in e.partition_by),
-                tuple((walk(oe), d) for oe, d in e.order_by))
-        if isinstance(e, FuncCall):
-            return FuncCall(e.name, tuple(walk(a) for a in e.args))
-        return e
+        return None
 
     from tpu_olap.planner.exprutil import map_stmt_exprs
     out = map_stmt_exprs(stmt, walk)
@@ -389,12 +386,17 @@ def _and_all(conjs):
     return out
 
 
-def _corr_split(s, outer_tables):
+_CMP_FLIP = {">": "<", "<": ">", ">=": "<=", "<=": ">=", "!=": "!="}
+
+
+def _corr_split(s, outer_tables, allow_cmp=False):
     """Split the subquery WHERE into correlation keys and residual:
     keys = [(inner_expr, outer Col)] from equality conjuncts referencing
-    the outer scope; residual = pure-inner conjuncts. Raises legibly for
-    any other correlation shape (non-equality, outer refs outside WHERE,
-    refs to a scope that is neither inner nor the immediate outer)."""
+    the outer scope; cmp_keys = [(inner_expr, op, outer Col)] from
+    comparison conjuncts (collected only when allow_cmp — the EXISTS
+    min/max reduction); residual = pure-inner conjuncts. Raises legibly
+    for any other correlation shape (outer refs outside WHERE, refs to a
+    scope that is neither inner nor the immediate outer)."""
     if isinstance(s, UnionStmt):
         raise FallbackError("correlated UNION subquery is not supported")
     inner_tables = _scope_names(s)
@@ -418,7 +420,7 @@ def _corr_split(s, outer_tables):
             return any(refs_outer(a) for a in x.args)
         return False
 
-    keys, residual = [], []
+    keys, cmp_keys, residual = [], [], []
     for c in _split_and(s.where):
         if not refs_outer(c):
             residual.append(c)
@@ -436,11 +438,27 @@ def _corr_split(s, outer_tables):
                     keys.append((ie, oe))
                     ok = True
                     break
+        elif allow_cmp and isinstance(c, BinOp) and c.op in _CMP_FLIP:
+            # normalize to inner_expr OP outer_col
+            for ie, oe, op in ((c.left, c.right, c.op),
+                               (c.right, c.left, _CMP_FLIP[c.op])):
+                if outer_col(oe) and not refs_outer(ie):
+                    qual = oe.name.rsplit(".", 1)[0]
+                    if qual not in outer_tables:
+                        raise FallbackError(
+                            f"subquery reference {oe.name!r} names a "
+                            "table in neither the subquery nor the "
+                            "immediately enclosing query")
+                    cmp_keys.append((ie, op, oe))
+                    ok = True
+                    break
         if not ok:
             raise FallbackError(
-                "correlated subquery: only equality correlation to an "
-                f"outer column is decorrelated (got {_auto_name(c)!r})")
-    if not keys:
+                "correlated subquery: only equality"
+                + ("/comparison" if allow_cmp else "")
+                + " correlation to an outer column is decorrelated "
+                f"(got {_auto_name(c)!r})")
+    if not keys and not cmp_keys:
         raise FallbackError(
             "correlated subquery reference outside WHERE is not "
             "supported (rewrite as a join)")
@@ -464,7 +482,7 @@ def _corr_split(s, outer_tables):
         raise FallbackError(
             "correlated subquery: outer reference in HAVING is not "
             "supported")
-    return keys, residual
+    return keys, cmp_keys, residual
 
 
 def _corr_shape_guard(s, what):
@@ -488,7 +506,7 @@ def _decorrelate_scalar(s, outer_tables, catalog, config, run):
         raise FallbackError(
             "correlated scalar subquery must project exactly one "
             "aggregate expression")
-    keys, residual = _corr_split(s, outer_tables)
+    keys, _cmp, residual = _corr_split(s, outer_tables)
     proj = s.projections[0][0]
     gproj = [(ie, f"__ck{i}") for i, (ie, _) in enumerate(keys)]
     inner = _dc.replace(
@@ -530,7 +548,40 @@ def _decorrelate_exists(s, outer_tables, catalog, config, run):
         # over zero input rows, so EXISTS is true for EVERY outer row
         # (group_by shapes never reach here: _corr_shape_guard rejects)
         return Lit(True)
-    keys, residual = _corr_split(s, outer_tables)
+    keys, cmp_keys, residual = _corr_split(s, outer_tables,
+                                           allow_cmp=True)
+    if cmp_keys:
+        # min/max reduction: EXISTS(... inner_e OP t.col AND eq-keys)
+        # <=> the per-eq-group extreme of inner_e satisfies OP against
+        # the outer value. Sound only for ONE comparison conjunct —
+        # two comparisons must hold on the SAME inner row, which
+        # min/max cannot witness
+        if len(cmp_keys) > 1:
+            raise FallbackError(
+                "correlated EXISTS: at most one comparison-correlation "
+                "conjunct is decorrelated")
+        ce, op, oe_cmp = cmp_keys[0]
+        inner = _dc.replace(
+            s, projections=[(ie, f"__ck{i}")
+                            for i, (ie, _) in enumerate(keys)]
+            + [(FuncCall("min", (ce,)), "__lo"),
+               (FuncCall("max", (ce,)), "__hi")],
+            distinct=False, group_by=[ie for ie, _ in keys],
+            where=_and_all(residual), order_by=[], limit=None, offset=0)
+        sub = run(inner)
+        kcols = [sub[f"__ck{j}"] for j in range(len(keys))]
+        items = []
+        for kt, lo, hi in zip(
+                _key_rows(kcols) if keys else ((),) * len(sub),
+                (_plain(v) for v in sub["__lo"].tolist()),
+                (_plain(v) for v in sub["__hi"].tolist())):
+            if any(k is None for k in kt) or lo is None:
+                continue  # NULL key never matches; all-NULL group: no
+            items.append((kt, (lo, hi)))   # non-null value to witness
+        return FuncCall(
+            "corr_exists_cmp_map",
+            (Lit(tuple(items)), Lit(op), oe_cmp)
+            + tuple(oe for _, oe in keys))
     inner = _dc.replace(
         s, projections=[(ie, f"__ck{i}") for i, (ie, _) in enumerate(keys)],
         distinct=True, group_by=[], where=_and_all(residual),
@@ -551,7 +602,7 @@ def _decorrelate_in(lhs, s, outer_tables, catalog, config, run):
     _corr_shape_guard(s, "IN subquery")
     if len(s.projections) != 1:
         raise FallbackError("IN subquery must project exactly one column")
-    keys, residual = _corr_split(s, outer_tables)
+    keys, _cmp, residual = _corr_split(s, outer_tables)
     ve = s.projections[0][0]
     inner = _dc.replace(
         s, projections=[(ie, f"__ck{i}")
@@ -1007,17 +1058,10 @@ def _execute_chunked(stmt: SelectStmt, entry, catalog, config):
     if stmt.distinct and not has_agg and not group_exprs:
         group_exprs = list(exprs)
 
-    def has_window(e):
-        if isinstance(e, WindowCall):
-            return True
-        if isinstance(e, BinOp):
-            return has_window(e.left) or has_window(e.right)
-        if isinstance(e, FuncCall):
-            return any(has_window(a) for a in e.args)
-        return False
+    from tpu_olap.planner.exprutil import contains_window
 
-    if any(has_window(x) for x in exprs) or \
-            any(has_window(o.expr) for o in stmt.order_by):
+    if any(contains_window(x) for x in exprs) or \
+            any(contains_window(o.expr) for o in stmt.order_by):
         # per-chunk window evaluation would silently restart partitions
         # at every chunk boundary; requiring the whole frame here would
         # be the OOM the chunked path exists to avoid
@@ -1835,6 +1879,33 @@ def _eval(e, df, time_col):
                 return pd.Series([], dtype=bool)
             return pd.Series([kt in keyset for kt in _key_rows(kser)],
                              index=df.index)
+        if fn == "corr_exists_cmp_map":
+            items = dict(e.args[0].value)
+            op = e.args[1].value
+            vser = _eval(e.args[2], df, time_col)
+            kser = [_eval(a, df, time_col) for a in e.args[3:]]
+            if not len(df):
+                return pd.Series([], dtype=bool)
+
+            def hit(kt, v):
+                rng = items.get(kt)
+                if rng is None or v is None or pd.isna(v):
+                    return False  # empty group / NULL comparand: UNKNOWN
+                lo, hi = rng
+                if op == ">":
+                    return hi > v
+                if op == ">=":
+                    return hi >= v
+                if op == "<":
+                    return lo < v
+                if op == "<=":
+                    return lo <= v
+                return lo != v or hi != v  # "!=": any differing value
+
+            kt_rows = _key_rows(kser) if kser else ((),) * len(df)
+            return pd.Series([hit(kt, v) for kt, v
+                              in zip(kt_rows, vser.tolist())],
+                             index=df.index)
         if fn == "corr_in_map":
             pairs = set(e.args[0].value)
             lhs = _eval(e.args[1], df, time_col)
@@ -1964,6 +2035,51 @@ def _eval_window(e: WindowCall, df, time_col) -> pd.Series:
 
     v = _eval_agg_input(e.args[0], df, time_col) if e.args else \
         pd.Series(1, index=df.index)
+    if e.frame is not None:
+        # explicit ROWS BETWEEN frame: sliding aggregate over the sorted
+        # partition. cumsum prefix differences serve sum/count/avg;
+        # min/max slice per row (fallback tier — partitions are small)
+        if not e.order_by:
+            raise FallbackError("a ROWS frame requires a window ORDER BY")
+        lo, hi = e.frame
+        if lo is not None and hi is not None and lo > hi:
+            raise FallbackError("empty ROWS frame (start after end)")
+        order = work.sort_values(order_cols, ascending=ascending,
+                                 kind="stable", key=_null_low_key).index
+        vs = v.reindex(order)
+        gk = [k.reindex(order) for k in grouped_keys]
+
+        def slide(s):
+            arr = s.to_numpy()
+            m = len(arr)
+            idx = np.arange(m)
+            notna = ~pd.isna(arr)
+            a = np.zeros(m, np.int64) if lo is None else \
+                np.clip(idx + lo, 0, m)
+            b = np.full(m, m, dtype=np.int64) if hi is None else \
+                np.clip(idx + hi + 1, 0, m)
+            b = np.maximum(a, b)
+            cn = np.concatenate([[0], np.cumsum(notna.astype(np.int64))])
+            cnt = cn[b] - cn[a]
+            if e.name == "count":
+                return pd.Series(cnt, index=s.index)
+            if e.name in ("sum", "avg"):
+                vals = np.where(notna, arr, 0).astype("float64")
+                cs = np.concatenate([[0.0], np.cumsum(vals)])
+                out = np.where(cnt > 0, cs[b] - cs[a], np.nan)
+                if e.name == "avg":
+                    out = out / np.where(cnt > 0, cnt, 1)
+                return pd.Series(out, index=s.index)
+            out = np.full(m, np.nan)
+            for i in range(m):
+                wv = arr[a[i]:b[i]]
+                wv = wv[~pd.isna(wv)]
+                if len(wv):
+                    out[i] = wv.min() if e.name == "min" else wv.max()
+            return pd.Series(out, index=s.index)
+
+        res = vs.groupby(gk, dropna=False, group_keys=False).apply(slide)
+        return res.reindex(df.index)
     if not e.order_by:
         g = by(v)
         if e.name == "count":

@@ -127,7 +127,8 @@ def _outside_subset(stmt) -> str | None:
     return None
 
 
-_FALLBACK_FUNCS = ("corr_scalar_map", "corr_exists_map", "corr_in_map")
+_FALLBACK_FUNCS = ("corr_scalar_map", "corr_exists_map", "corr_in_map",
+                   "corr_exists_cmp_map")
 
 
 def _scan_stmt_nodes(stmt):
@@ -168,6 +169,101 @@ def _scan_stmt_nodes(stmt):
     return subs, flags["window"], flags["corr"]
 
 
+def _apply_windows_over_groups(stmt):
+    """Recursive application of the grouped-window rewrite: union parts,
+    derived tables (incl. inlined CTEs), and join subqueries each get
+    the same treatment as the top-level statement."""
+    from tpu_olap.planner.sqlparse import UnionStmt
+    if isinstance(stmt, UnionStmt):
+        stmt.parts = [_apply_windows_over_groups(p) for p in stmt.parts]
+        return stmt
+    if stmt.derived is not None:
+        stmt.derived = _apply_windows_over_groups(stmt.derived)
+    for j in stmt.joins:
+        if j.derived is not None:
+            j.derived = _apply_windows_over_groups(j.derived)
+    return _windows_over_groups(stmt)
+
+
+def _windows_over_groups(stmt):
+    """Standard SQL evaluates window functions AFTER grouping, over the
+    grouped rows. The fallback interpreter already evaluates windows
+    over derived tables, so a grouped query containing a window rewrites
+    to exactly that: an inner SELECT doing the grouping (group keys +
+    every aggregate the outer mentions, auto-named), and an outer SELECT
+    evaluating the windows over it. `SELECT cat, rank() OVER (ORDER BY
+    sum(p) DESC) FROM t GROUP BY cat` becomes `SELECT cat, rank() OVER
+    (ORDER BY __a0 DESC) FROM (SELECT cat, sum(p) AS __a0 ... GROUP BY
+    cat)`. (The reference served these through Spark SQL, SURVEY.md
+    §3.1.)"""
+    from tpu_olap.ir.expr import WindowCall
+    from tpu_olap.planner.exprutil import contains_window
+    from tpu_olap.planner.sqlparse import AGG_FUNCS, SelectStmt
+
+    outer_exprs = [p for p, _ in stmt.projections] \
+        + [o.expr for o in stmt.order_by]
+    if not stmt.group_by or not any(contains_window(e)
+                                    for e in outer_exprs):
+        return stmt
+
+    aggs: dict = {}  # expr key -> FuncCall
+
+    def collect(e):
+        if isinstance(e, FuncCall) and e.name in AGG_FUNCS:
+            aggs.setdefault(_key(e), e)
+            return
+        if isinstance(e, BinOp):
+            collect(e.left)
+            collect(e.right)
+        elif isinstance(e, WindowCall):
+            for a in e.args:
+                collect(a)
+            for p in e.partition_by:
+                collect(p)
+            for oe, _ in e.order_by:
+                collect(oe)
+        elif isinstance(e, FuncCall):
+            for a in e.args:
+                collect(a)
+
+    for e in outer_exprs:
+        collect(e)
+
+    # inner projections: group keys first (plain Cols keep their name,
+    # computed keys get stable synthetic names), then the aggregates
+    sub: dict = {}  # expr key -> replacement Col
+    inner_proj = []
+    for i, g in enumerate(stmt.group_by):
+        name = g.name if isinstance(g, Col) else f"__g{i}"
+        inner_proj.append((g, None if isinstance(g, Col) else name))
+        sub[_key(g)] = Col(name)
+    for j, (k, a) in enumerate(sorted(aggs.items())):
+        inner_proj.append((a, f"__a{j}"))
+        sub[k] = Col(f"__a{j}")
+
+    from tpu_olap.ir.expr import map_expr
+
+    def rewrite(e):
+        return map_expr(e, lambda x: sub.get(_key(x)))
+
+    inner = SelectStmt(
+        projections=inner_proj, table=stmt.table, joins=stmt.joins,
+        where=stmt.where, group_by=stmt.group_by, having=stmt.having,
+        table_alias=stmt.table_alias, grouping_sets=stmt.grouping_sets,
+        derived=stmt.derived)
+    outer = SelectStmt(
+        # unaliased projections keep the ORIGINAL expression's rendered
+        # name — the rewritten tree would leak __a0/__g0 into headers
+        projections=[(rewrite(p), alias or _render(p))
+                     for p, alias in stmt.projections],
+        table="__winagg", derived=inner, distinct=stmt.distinct,
+        limit=stmt.limit, offset=stmt.offset)
+    for o in stmt.order_by:
+        o.expr = rewrite(o.expr)
+    outer.order_by = stmt.order_by
+    return outer
+
+
 class DruidPlanner:
     """Registers no global state — one instance per Engine (the reference's
     DruidPlanner(sqlContext) kept per-session rule lists, SURVEY.md §3.2)."""
@@ -183,12 +279,74 @@ class DruidPlanner:
     def plan(self, sql: str) -> PlanResult:
         return self.plan_stmt(parse_sql(sql), sql)
 
+    def _scope_columns(self, stmt) -> set:
+        """Source column names visible to this statement's GROUP BY /
+        ORDER BY: base/join tables from the catalog (footer-cheap) plus
+        derived-table output names. Best-effort — an unknown table just
+        contributes nothing, and alias substitution stays conservative
+        (a name that might be a column is never treated as an alias)."""
+        from tpu_olap.ir.expr import Col
+        from tpu_olap.planner.sqlparse import UnionStmt
+        cols: set = set()
+
+        def add_derived(d):
+            sel = d.parts[0] if isinstance(d, UnionStmt) else d
+            for p, alias in sel.projections:
+                if alias:
+                    cols.add(alias)
+                elif isinstance(p, Col):
+                    cols.add(p.name)
+
+        def add_entry(name):
+            ent = self.catalog.maybe(name)
+            if ent is not None:
+                try:
+                    cols.update(ent.column_names())
+                except Exception:  # noqa: BLE001 — unreadable footer etc.
+                    pass
+
+        if stmt.derived is not None:
+            add_derived(stmt.derived)
+        elif stmt.table:
+            add_entry(stmt.table)
+        for j in stmt.joins:
+            if j.derived is not None:
+                add_derived(j.derived)
+            else:
+                add_entry(j.table)
+        return cols
+
+    def _resolve_aliases(self, stmt):
+        """Apply output-alias resolution to a statement tree: each
+        SELECT scope (union parts, derived tables, join subqueries)
+        resolves against its own FROM columns."""
+        from tpu_olap.planner.sqlparse import (UnionStmt,
+                                               resolve_output_aliases)
+        if isinstance(stmt, UnionStmt):
+            for p in stmt.parts:
+                self._resolve_aliases(p)
+            return stmt
+        if stmt.derived is not None:
+            self._resolve_aliases(stmt.derived)
+        for j in stmt.joins:
+            if j.derived is not None:
+                self._resolve_aliases(j.derived)
+        # cheap early-out before touching catalog metadata: resolution
+        # can only matter when some projection is aliased AND a
+        # GROUP BY / ORDER BY clause exists to reference it
+        if not ((stmt.group_by or stmt.order_by or stmt.grouping_sets)
+                and any(alias for _, alias in stmt.projections)):
+            return stmt
+        return resolve_output_aliases(stmt, self._scope_columns(stmt))
+
     def plan_stmt(self, stmt, sql: str = "") -> PlanResult:
         # shapes outside the rewrite rules run on the fallback path (the
         # reference delegated them to full Spark SQL, SURVEY.md §3.1) —
         # declined here, never an error
         from tpu_olap.planner.exprutil import simplify_stmt
         from tpu_olap.planner.sqlparse import UnionStmt
+        stmt = self._resolve_aliases(stmt)
+        stmt = _apply_windows_over_groups(stmt)
         if not isinstance(stmt, UnionStmt):
             # normalize expressions once so the rewriter and the fallback
             # interpreter see the same tree (ExprUtil, SURVEY.md §3.2)
@@ -1141,7 +1299,8 @@ class _Rewriter:
         if self.stmt.having is not None:
             having_spec = self._to_having(self._resolve(self.stmt.having))
 
-        limit_spec, topn = self._limit_transform(dims, granularity, outputs)
+        limit_spec, topn = self._limit_transform(dims, granularity, outputs,
+                                                 group_outputs)
 
         common = dict(
             data_source=self.entry.name,
@@ -1226,7 +1385,8 @@ class _Rewriter:
                 return NotHaving(EqualToHaving(name, v))
         raise RewriteError(f"cannot translate HAVING {_render(e)}")
 
-    def _limit_transform(self, dims, granularity, outputs):
+    def _limit_transform(self, dims, granularity, outputs,
+                         group_outputs=None):
         """ORDER BY + LIMIT -> LimitSpec; TopN eligibility per the
         reference's allowTopN rule (SURVEY.md §3.2 LimitTransform)."""
         stmt = self.stmt
@@ -1236,6 +1396,11 @@ class _Rewriter:
         for o in outputs:
             by_source.setdefault(o.name, o.source)
             by_source.setdefault(o.source, o.source)
+        # ORDER BY a grouped EXPRESSION (e.g. the source column of an
+        # aliased dim): resolve through the group-expr key map, not just
+        # output names
+        group_by_key = {k: oc.source
+                        for k, oc in (group_outputs or {}).items()}
         cols = []
         for item in stmt.order_by:
             if item.nulls is not None:
@@ -1253,6 +1418,8 @@ class _Rewriter:
                 # the written name: star-join renames (r_name -> c_region)
                 # resolve the expr away from the output header it matches
                 src = by_source[item.expr.name.split(".")[-1]]
+            elif key in group_by_key:
+                src = group_by_key[key]
             elif _contains_agg(e):
                 src = self._agg_output(e)
             else:

@@ -457,7 +457,7 @@ class _Parser:
                 self.take()
                 vals.append(self.add())
             self.take("op", ")")
-            return FuncCall("in_list", (e, *vals))
+            return _expand_tuple_in(e, vals)
         if self.at_kw("like"):
             self.take()
             pat = self.add()
@@ -491,7 +491,7 @@ class _Parser:
                 self.take()
                 vals.append(self.add())
             self.take("op", ")")
-            return FuncCall("in_list", (e, *vals))
+            return _expand_tuple_in(e, vals)
         if self.at_kw("like"):
             self.take()
             return FuncCall("like", (e, self.add()))
@@ -510,8 +510,28 @@ class _Parser:
             op = self.take()
             if op == "||":  # SQL string concatenation
                 e = FuncCall("concat", (e, self.mul()))
+                continue
+            rhs = self.mul()
+            l_iv = isinstance(e, FuncCall) and e.name == "__interval"
+            r_iv = isinstance(rhs, FuncCall) and rhs.name == "__interval"
+            if l_iv and r_iv:
+                raise SqlError("INTERVAL +/- INTERVAL is not supported")
+            if r_iv:
+                e = _fold_interval(e, op, rhs)
+            elif l_iv:
+                # commuted form: INTERVAL + TIMESTAMP (subtraction from
+                # an interval has no meaning)
+                if op != "+":
+                    raise SqlError(
+                        "INTERVAL may only be subtracted FROM a "
+                        "timestamp, not the reverse")
+                e = _fold_interval(rhs, "+", e)
             else:
-                e = BinOp(op, e, self.mul())
+                e = BinOp(op, e, rhs)
+        if isinstance(e, FuncCall) and e.name == "__interval":
+            raise SqlError(
+                "INTERVAL literal is only valid in +/- timestamp "
+                "arithmetic")
         return e
 
     def mul(self):
@@ -561,9 +581,23 @@ class _Parser:
             return FuncCall(fn, (e,))
         if k == "name":
             self.take()
+            vl = v.lower()
+            # typed literals: TIMESTAMP '...' / DATE '...' are plain
+            # string literals to the engine (every time comparison path
+            # parses ISO-ish strings); INTERVAL '...' UNIT is a marker
+            # the additive parser folds into timestamp arithmetic
+            if vl in ("timestamp", "date") and self.peek()[0] == "str":
+                return Lit(self.take("str"))
+            if vl == "interval" and self.peek()[0] in ("str", "num"):
+                amt = self.take()
+                unit = str(self.take("name")).lower().rstrip("s")
+                if unit not in ("year", "month", "week", "day", "hour",
+                                "minute", "second"):
+                    raise SqlError(f"unknown INTERVAL unit {unit!r}")
+                return FuncCall("__interval", (Lit(str(amt)), Lit(unit)))
             if self.peek() == ("op", "("):
                 self.take()
-                fname = v.lower()
+                fname = vl
                 if fname == "extract":
                     # EXTRACT(YEAR FROM ts) -> year(ts) etc.
                     unit = str(self.take("name")).lower()
@@ -635,6 +669,16 @@ class _Parser:
                 self.take("op", ")")
                 return Subquery(sub)
             e = self.expr()
+            if self.peek() == ("op", ","):
+                # (a, b, ...) row constructor — only meaningful as the
+                # LHS/elements of a tuple IN, which expands it away;
+                # anywhere else the unknown "row" function errs legibly
+                parts = [e]
+                while self.peek() == ("op", ","):
+                    self.take()
+                    parts.append(self.expr())
+                self.take("op", ")")
+                return FuncCall("row", tuple(parts))
             self.take("op", ")")
             return e
         raise SqlError(f"unexpected token {v!r}")
@@ -662,8 +706,59 @@ class _Parser:
                     "NULLS FIRST/LAST in a window ORDER BY is not "
                     "supported")
             order = [(e, d) for e, d, _ in items]
+        frame = None
+        k, v = self.peek()
+        if k == "name" and v.lower() in ("rows", "range"):
+            if v.lower() == "range":
+                raise SqlError(
+                    "RANGE frames are not supported; use ROWS")
+            self.take()
+
+            def bound(is_start):
+                """One frame bound; UNBOUNDED must point OUTWARD from
+                the current row (PRECEDING as a start, FOLLOWING as an
+                end) — the inward spellings are invalid SQL and would
+                otherwise silently flip the frame's meaning."""
+                bk, bv = self.peek()
+                if bk == "name" and bv.lower() == "unbounded":
+                    self.take()
+                    d = str(self.take("name")).lower()
+                    want = "preceding" if is_start else "following"
+                    if d != want:
+                        raise SqlError(
+                            f"UNBOUNDED {d.upper()} is not a valid "
+                            f"frame {'start' if is_start else 'end'}")
+                    return None
+                if bk == "name" and bv.lower() == "current":
+                    self.take()
+                    d = str(self.take("name")).lower()
+                    if d != "row":
+                        raise SqlError(f"expected CURRENT ROW, got "
+                                       f"CURRENT {d.upper()}")
+                    return 0
+                raw = self.take("num")
+                if float(raw) != int(raw):
+                    raise SqlError(
+                        f"ROWS frame bound must be an integer, "
+                        f"got {raw!r}")
+                n = int(raw)
+                d = str(self.take("name")).lower()
+                if d not in ("preceding", "following"):
+                    raise SqlError(f"expected PRECEDING/FOLLOWING, "
+                                   f"got {d!r}")
+                return -n if d == "preceding" else n
+
+            if self.at_kw("between"):
+                self.take()
+                lo = bound(True)
+                self.take_kw("and")
+                hi = bound(False)
+            else:
+                lo, hi = bound(True), 0  # ROWS n PRECEDING
+            frame = (lo, hi)
         self.take("op", ")")
-        return WindowCall(fname, args, tuple(partition), tuple(order))
+        return WindowCall(fname, args, tuple(partition), tuple(order),
+                          frame)
 
     def _grouping_sets(self, stmt):
         """GROUP BY ROLLUP(a, b) | CUBE(a, b) | GROUPING SETS((a,b),(a),())
@@ -788,6 +883,109 @@ def _resolve_ordinal(e, stmt):
             f"ordinal {n} out of range (select list has "
             f"{len(stmt.projections)} items)")
     return stmt.projections[n - 1][0]
+
+
+def _fold_interval(e, op, interval):
+    """TIMESTAMP '...' +/- INTERVAL 'n' UNIT folds to a literal
+    timestamp string at parse time (the shape BI date-window predicates
+    take). Non-literal operands reject legibly — column +/- INTERVAL has
+    no engine spelling yet."""
+    import pandas as pd
+    amt, unit = interval.args[0].value, interval.args[1].value
+    if not (isinstance(e, Lit) and isinstance(e.value, str)):
+        raise SqlError(
+            "INTERVAL arithmetic needs a TIMESTAMP/DATE literal operand")
+    try:
+        n = float(amt)
+        base = pd.Timestamp(e.value)
+    except ValueError as err:
+        raise SqlError(f"bad INTERVAL arithmetic operand: {err}") from None
+    if unit in ("year", "month"):
+        if n != int(n):
+            raise SqlError(f"fractional INTERVAL {unit} not supported")
+        delta = pd.DateOffset(**{unit + "s": int(n)})
+    else:
+        delta = pd.Timedelta(**{unit + "s": n})
+    out = base + delta if op == "+" else base - delta
+    return Lit(str(out))
+
+
+def _expand_tuple_in(e, vals):
+    """(a, b) IN ((x, y), ...) -> OR of per-row AND equalities — runs on
+    both execution paths with no new IR (selector/and/or filters)."""
+    if not (isinstance(e, FuncCall) and e.name == "row"):
+        return FuncCall("in_list", (e, *vals))
+    ors = None
+    for vrow in vals:
+        if not (isinstance(vrow, FuncCall) and vrow.name == "row"
+                and len(vrow.args) == len(e.args)):
+            raise SqlError("tuple IN needs matching-arity row literals")
+        ands = None
+        for a, b in zip(e.args, vrow.args):
+            c = BinOp("==", a, b)
+            ands = c if ands is None else BinOp("&&", ands, c)
+        ors = ands if ors is None else BinOp("||", ors, ands)
+    return ors if ors is not None else Lit(False)
+
+
+def _sub_names(e, sub: dict):
+    """Rebuild expression `e` with every bare Col whose name is in `sub`
+    replaced by the mapped expression. Subquery internals are an inner
+    scope and stay untouched; window specs substitute like any other
+    expression position."""
+    from tpu_olap.ir.expr import map_expr
+    return map_expr(e, lambda x: sub.get(x.name)
+                    if isinstance(x, Col) else None)
+
+
+def resolve_output_aliases(stmt, scope_columns: set):
+    """Standard-SQL output-alias references: a bare name in GROUP BY /
+    ORDER BY that names a projection alias AND does not shadow a source
+    column resolves to the aliased expression (Spark/MySQL semantics —
+    the reference served these through full Spark SQL, SURVEY.md §3.1).
+    Source columns win on conflict, so existing queries are unchanged.
+    Aliases may reference earlier aliases; substitution iterates to a
+    bounded fixpoint (mutually-recursive aliases stop at the cap)."""
+    from tpu_olap.ir.expr import WindowCall
+
+    def non_substitutable(e):
+        # window- and grouping()-valued aliases stay as output-column
+        # references: the fallback sorter matches them by name, and
+        # neither can be re-evaluated inside ORDER BY expressions
+        if isinstance(e, WindowCall):
+            return True
+        if isinstance(e, BinOp):
+            return non_substitutable(e.left) or non_substitutable(e.right)
+        if isinstance(e, FuncCall):
+            if e.name == "grouping":
+                return True
+            return any(non_substitutable(a) for a in e.args)
+        return False
+
+    sub = {}
+    for p, alias in stmt.projections:
+        if alias and alias not in scope_columns \
+                and not (isinstance(p, Col) and p.name == alias) \
+                and not non_substitutable(p):
+            sub[alias] = p
+    if not sub:
+        return stmt
+
+    def fix(e):
+        for _ in range(5):
+            new = _sub_names(e, sub)
+            if new == e:
+                return e
+            e = new
+        return e
+
+    stmt.group_by = [fix(e) for e in stmt.group_by]
+    if stmt.grouping_sets is not None:
+        stmt.grouping_sets = [[fix(e) for e in s]
+                              for s in stmt.grouping_sets]
+    for oi in stmt.order_by:
+        oi.expr = fix(oi.expr)
+    return stmt
 
 
 def _inline_ctes(stmt, ctes: dict):
