@@ -135,7 +135,8 @@ def test_pallas_minmax_parity(sql):
 
 def test_pallas_group_cap_guard():
     plain = Engine(EngineConfig(use_pallas="never"))
-    forced = Engine(EngineConfig(use_pallas="force", pallas_group_cap=4))
+    forced = Engine(EngineConfig(use_pallas="force", pallas_group_cap=4,
+                                 pallas_group_cap_factorized=4))
     df = _table()
     for e in (plain, forced):
         e.register_table("t", df, time_column="ts", block_rows=512)
@@ -360,3 +361,37 @@ def test_pallas_factorized_boundary_sweep():
     cfg = EngineConfig()
     assert factorization(2, 9, 0, cfg) is None
     assert factorization(1001, 9, 0, cfg) is not None
+
+
+def test_pallas_factorized_beyond_direct_cap():
+    """Group spaces past pallas_group_cap stay on the kernel when the
+    layout factorizes (pallas_group_cap_factorized); min/max layouts
+    (no factorization) still reject legibly."""
+    rng = np.random.default_rng(31)
+    n = 4096
+    df = pd.DataFrame({
+        "ts": pd.to_datetime("2022-01-01")
+        + pd.to_timedelta(rng.integers(0, 86400 * 5, n), unit="s"),
+        "g": rng.integers(0, 20000, n).astype(np.int64),
+        "v": rng.integers(0, 1000, n).astype(np.int64),
+    })
+    plain = Engine(EngineConfig(use_pallas="never"))
+    forced = Engine(EngineConfig(use_pallas="force"))
+    for e in (plain, forced):
+        e.register_table("big_k", df, time_column="ts", block_rows=512)
+    q = ("SELECT g, sum(v) AS s, count(*) AS n FROM big_k "
+         "GROUP BY g ORDER BY g")
+    a, b = plain.sql(q), forced.sql(q)
+    plan = forced.planner.plan(q)
+    phys = lower(plan.query, plan.entry.segments, forced.config)
+    assert phys.total_groups > forced.config.pallas_group_cap
+    assert phys.pallas_reason is None, phys.pallas_reason
+    pd.testing.assert_frame_equal(a, b)
+    # a min/max agg blocks factorization -> legible decline past the cap
+    q2 = "SELECT g, min(v) AS m FROM big_k GROUP BY g ORDER BY g"
+    plan2 = forced.planner.plan(q2)
+    phys2 = lower(plan2.query, plan2.entry.segments, forced.config)
+    assert phys2.pallas_reason is not None
+    assert "does not factorize" in phys2.pallas_reason
+    a2, b2 = plain.sql(q2), forced.sql(q2)
+    pd.testing.assert_frame_equal(a2, b2)

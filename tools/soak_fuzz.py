@@ -74,7 +74,7 @@ def main():
     start = int(os.environ.get("SOAK_SEED_START", 0))
     n = int(os.environ.get("SOAK_N", 1000))
     tag = os.environ.get("SOAK_TAG", "r04")
-    chunk = int(os.environ.get("SOAK_CHUNK", 100))
+    chunk = int(os.environ.get("SOAK_CHUNK", 25))
     t0 = time.time()
 
     if os.environ.get("SOAK_INLINE"):
@@ -84,7 +84,8 @@ def main():
 
     # chunked in subprocesses: every seed compiles fresh XLA executables
     # into process-global caches, so a single 1000-seed process grows
-    # without bound (observed: OOM-killed at 127 GB RSS around seed 200)
+    # without bound (observed: OOM-killed at 127 GB RSS around seed 200;
+    # a 100-seed chunk still reached ~100 GB — 25 keeps the peak ~25 GB)
     import subprocess
     counts = {"ok": 0, "fallback": 0, "fail": 0, "error": 0}
     failures = []

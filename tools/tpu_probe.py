@@ -180,6 +180,12 @@ EXTRA_LEGS = [
     # scatter path because the inverted first fit routed them there.)
     ("pallas tiling sweep", _file_done("PALLAS_SWEEP_TPU.json"),
      lambda: attempt_cmd(["tools/sweep_pallas_tpu.py"])),
+    # second-window additions: the SF20 single-chip over-proof (1.6x the
+    # SF100/v5e-8 per-chip row load, exercises HBM eviction) — dataset
+    # cached under .ssb_data by the first run, so a re-bank spends the
+    # window on ingest+queries only
+    ("sf20 bench", _file_done("BENCH_TPU_SF20.json"),
+     _bench_leg("BENCH_TPU_SF20.json", rows=120_000_000)),
 ]
 MAX_LEG_FAILURES = 2  # deterministic failures must not eat the window
 

@@ -141,6 +141,13 @@ class EngineConfig:
     # max dense group count the Pallas kernel serves — beyond this the
     # VPU compare cost (K·N comparisons across K-blocks) beats scatter
     pallas_group_cap: int = 8192
+    # factorized lane packing (kernels.pallas_reduce.Factorization) cuts
+    # the tile product to ~K*H, so factorizable layouts stay profitable
+    # well past the direct cap: the measured on-chip win extends through
+    # 2.1e13 FLOPs with no loss observed (PALLAS_SWEEP_TPU.json,
+    # BENCH_TPU_SF20.json). Non-factorizable plans (min/max aggs, wide
+    # H) keep the stricter cap above.
+    pallas_group_cap_factorized: int = 65536
     pallas_rows_per_block: int = 1024
     # K-block tile height: group spaces wider than this tile over a second
     # grid axis ([KB, rb] one-hot per step instead of one [K, rb] tile)

@@ -9,6 +9,7 @@ reference's DruidQueryHistory (SURVEY.md §3.2 "Query-history").
 
 from __future__ import annotations
 
+import functools
 import time
 from dataclasses import dataclass, field
 
@@ -1025,16 +1026,33 @@ class QueryRunner:
                 table.name)
             mask = np.asarray(partials["mask"]).reshape(
                 -1, table.block_rows)[:len(table.segments)]
-            for dim in coded:
+            # per-dimension masked value counts ON DEVICE in one extra
+            # jitted call (device-side scatter-adds over the resident
+            # code columns measure ~0.2 ms for all SSB dims at SF1; any
+            # host-side per-row pass costs seconds at this host's memory
+            # bandwidth), fetched as ONE packed vector
+            ds = self._dataset(table)
+            cards = tuple(table.dictionaries[d].cardinality
+                          for d in coded)
+            cols = tuple(ds.col(d) for d in coded)
+            dev_mask = partials["mask"]
+            if dev_mask.size == cols[0].size:
+                packed = np.asarray(
+                    _search_counts_packed(cards, dev_mask, cols))
+            else:  # partial dispatch coverage: host fallback
+                flat_mask = mask.reshape(-1)
+                parts = []
+                for dim, card in zip(coded, cards):
+                    flat = np.concatenate(
+                        [s.columns[dim] for s in table.segments])
+                    parts.append(np.bincount(flat[flat_mask],
+                                             minlength=card + 1))
+                packed = np.concatenate(parts)
+            off = 0
+            for dim, card in zip(coded, cards):
                 d = table.dictionaries[dim]
-                counts = np.zeros(d.cardinality + 1, np.int64)
-                for s in table.segments:
-                    m = mask[s.meta.segment_id]
-                    if not m.any():
-                        continue
-                    codes = s.columns[dim][m]
-                    counts += np.bincount(codes,
-                                          minlength=d.cardinality + 1)
+                counts = packed[off:off + card + 1]
+                off += card + 1
                 for code in np.nonzero(counts[1:])[0]:
                     v = d.values[code]
                     if matcher(v):
@@ -1099,6 +1117,33 @@ def _invert_sort_key(k: np.ndarray):
     # lexicographic descending for strings: invert via codes trick
     uniq, inv = np.unique(k, return_inverse=True)
     return -inv
+
+
+_search_counts_jit = None
+
+
+def _search_counts_packed(cards: tuple, mask, cols):
+    """One jitted program: masked value counts for every searched
+    dimension, concatenated so the host fetches a single small vector.
+    Code 0 is the NULL slot (bincount layout identical to the host
+    np.bincount(minlength=card+1) it replaces). The jit wrapper is
+    module-cached; distinct (cards, shapes) compile once each."""
+    global _search_counts_jit
+    if _search_counts_jit is None:
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, static_argnums=0)
+        def run(cards, mask, cols):
+            m = mask.reshape(-1).astype(jnp.int32)
+            outs = [jnp.zeros(c + 1, jnp.int32)
+                    .at[col.reshape(-1).astype(jnp.int32)]
+                    .add(m, mode="drop")
+                    for c, col in zip(cards, cols)]
+            return jnp.concatenate(outs)
+
+        _search_counts_jit = run
+    return _search_counts_jit(cards, mask, tuple(cols))
 
 
 def _search_sort_key(sort: str, value: str):

@@ -409,8 +409,33 @@ def eligible(query, plan, table, config, filter_fn=None) -> str | None:
     if TIME_COLUMN in kcols:
         return "raw __time read inside the kernel"
     if plan.total_groups > config.pallas_group_cap:
-        return (f"group space {plan.total_groups} exceeds pallas cap "
-                f"{config.pallas_group_cap}")
+        # past the direct cap, only the factorized lane packing keeps
+        # the tile product (and the VPU compare cost) in the win regime
+        # — and computing the layout needs the bounds scan, so do the
+        # cheap hard-cap check first
+        if plan.total_groups > config.pallas_group_cap_factorized:
+            return (f"group space {plan.total_groups} exceeds pallas "
+                    f"cap {config.pallas_group_cap_factorized}")
+        bad = next((p.kind for p in plan.agg_plans
+                    if p.kind not in ("count", "sum", "min", "max")), None)
+        if bad is not None:  # plan_layout would KeyError on e.g. HLL
+            return f"aggregation kind {bad!r}"
+        try:
+            # plan_layout subscripts sum-input bounds — an unboundable
+            # sum stores None there (the under-cap path rejects it
+            # later with its own reason), so probe the bounds first
+            sb = sum_bounds(plan, table)
+            missing = next((k for k, v in sb.items() if v is None), None)
+            if missing is not None:
+                return f"cannot bound sum input of {missing!r}"
+            layout = _layout_for(plan, table)
+        except _Ineligible as e:
+            return str(e)
+        if factorization(plan.total_groups, layout.n_cols,
+                         layout.n_minmax, config) is None:
+            return (f"group space {plan.total_groups} exceeds pallas "
+                    f"cap {config.pallas_group_cap} and the layout "
+                    "does not factorize")
     if table.block_rows % 128 != 0:
         return f"block_rows {table.block_rows} not a multiple of 128"
     rb = min(table.block_rows, config.pallas_rows_per_block)

@@ -914,6 +914,11 @@ def _expand_tuple_in(e, vals):
     """(a, b) IN ((x, y), ...) -> OR of per-row AND equalities — runs on
     both execution paths with no new IR (selector/and/or filters)."""
     if not (isinstance(e, FuncCall) and e.name == "row"):
+        if any(isinstance(v, FuncCall) and v.name == "row"
+               for v in vals):
+            raise SqlError(
+                "IN list contains a (…, …) row literal but the "
+                "left-hand side is not a row")
         return FuncCall("in_list", (e, *vals))
     ors = None
     for vrow in vals:
