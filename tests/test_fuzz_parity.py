@@ -114,6 +114,17 @@ _FILTERS = [
     "NOT (城市 = peer) OR cat = 'alpha'",
     "small = qty",
     "small <> qty",
+    # round-4 second window: tuple IN (parse-time OR-of-AND expansion),
+    # TIMESTAMP/INTERVAL literal folding, and comparison-correlated
+    # EXISTS (the per-group min/max reduction) — all deterministic
+    "(cat, region) IN (('alpha', 'west'), ('beta', 'east'))",
+    "(region, small) IN (('west', 1), ('east', 3), ('west', 5))",
+    "ts < TIMESTAMP '2019-09-01' - INTERVAL '15' DAY",
+    "ts >= DATE '2019-03-01' + INTERVAL 1 MONTH",
+    "EXISTS (SELECT 1 FROM t t2 WHERE t2.qty > t.qty "
+    "AND t2.城市 = t.城市)",
+    "NOT EXISTS (SELECT 1 FROM t t2 WHERE t2.price > t.price "
+    "AND t2.cat = t.cat)",
 ]
 _TIME_EXPRS = [None, "year(ts)", "month(ts)", "quarter(ts)",
                "date_trunc('day', ts)"]
@@ -170,6 +181,18 @@ def _gen_query(rng):
         if use_ordinals:
             sql += " GROUP BY " + ", ".join(
                 str(i + 1) for i in range(len(group)))
+        elif rng.random() < 0.25:
+            # output-alias references (round-4 second window): the
+            # extract/time group keys may be named by their SELECT alias
+            keys = []
+            for g in group:
+                if g in _EXTRACT_DIMS:
+                    keys.append("xd")
+                elif g not in dims:
+                    keys.append("tg")
+                else:
+                    keys.append(g)
+            sql += " GROUP BY " + ", ".join(keys)
         else:
             sql += " GROUP BY " + ", ".join(group)
         if rng.random() < 0.3:

@@ -106,11 +106,18 @@ def main():
             for k, v in rec["counts"].items():
                 counts[k] += v
             failures.extend(rec["failures"])
+            if rec["failures"]:
+                print("[soak] first failure this chunk: "
+                      + rec["failures"][0]["error"][:400],
+                      file=sys.stderr, flush=True)
         else:
             counts["error"] += m
             failures.append({"seed": start + done,
                              "error": "chunk crashed: "
                              + proc.stderr[-500:]})
+            print(f"[soak] chunk {start + done} crashed rc="
+                  f"{proc.returncode}: ...{proc.stderr[-300:]}",
+                  file=sys.stderr, flush=True)
         done += m
         print(f"[soak] {done}/{n} counts={counts}",
               file=sys.stderr, flush=True)

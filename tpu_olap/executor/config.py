@@ -43,6 +43,15 @@ class EngineConfig:
     # clamped below the dense-path theta_k_cap. 256 -> ~6% RSE, the
     # sketch-shrink-under-memory-pressure tradeoff Druid also makes.
     sparse_theta_k_cap: int = 256
+    # max [groups × radix] element count of dense per-group sketch state
+    # (theta value tables, HLL register files). Past it a GroupBy takes
+    # the sparse path (clamped sketch width) and other shapes decline
+    # legibly — without this, a wide-group theta/HLL query allocates
+    # K × k state long before K exceeds dense_group_budget (observed:
+    # >100 GB at K ≈ 1M). 2^28 elements ≈ 2 GB int64 state keeps
+    # legitimately-sized dense queries (e.g. hourly-year theta
+    # timeseries) on the dense path
+    dense_sketch_state_budget: int = 1 << 28
     # multi-chip sparse merge strategy: "exchange" = hash-partitioned
     # all_to_all (present groups scale with chip count: capacity is
     # D x sparse_group_budget when keys distribute), "gather" = legacy

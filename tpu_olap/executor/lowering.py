@@ -108,6 +108,30 @@ def lower(query, table, config) -> PhysicalPlan:
         f"no device lowering for {type(query).__name__}")
 
 
+def _sparse_reject_reason(query, total, config) -> str | None:
+    """None when the sort-based sparse path can serve this shape, else
+    why not — the single source of truth for both the over-budget
+    routing decision and the in-branch rejections (GroupBy only: the
+    timeseries/topN assemblers index the dense bucket space)."""
+    if not isinstance(query, GroupByQuerySpec):
+        return f"{query.query_type} has no sparse path"
+    if total >= (1 << 62):
+        return "the group space overflows the int64 sparse key"
+    if not config.enable_x64:
+        return "sparse group-by needs int64 keys (enable_x64=False)"
+    return None
+
+
+def _radix(p) -> int:
+    """Per-group state width of an aggregation plan: HLL register file,
+    theta value table, or 1 for scalar accumulators. Shared by the
+    sketch-state budget and the no-x64 int32 index guard."""
+    from tpu_olap.kernels.hll import NUM_REGISTERS
+    if p.kind == "hll":
+        return NUM_REGISTERS
+    return p.theta_k if p.kind == "theta" else 1
+
+
 def _time_range(query, table):
     intervals = query.intervals or (ETERNITY,)
     t0, t1 = table.time_boundary
@@ -303,21 +327,32 @@ def _lower_agg(query, table, config) -> PhysicalPlan:
     total = 1
     for s in sizes:
         total *= s
+    # sketch aggregates keep [groups × radix] state PER AGGREGATION: at
+    # large K their TOTAL dominates memory long before the group COUNT
+    # exceeds the dense budget (observed: a 1M-group theta query
+    # allocating >100 GB). Budget the summed state element count — over
+    # budget, the sparse path (clamped sketch width) serves it when it
+    # can; shapes with no sparse path decline legibly, never allocate
+    state_radix = sum(_radix(p) for p in agg_plans if _radix(p) > 1)
+    sketch_over = (state_radix > 0
+                   and total * state_radix
+                   > config.dense_sketch_state_budget)
     sparse = total > config.dense_group_budget
+    if sketch_over and not sparse:
+        reject = _sparse_reject_reason(query, total, config)
+        if reject is not None:
+            raise UnsupportedAggregation(
+                f"per-group sketch state {total}×{state_radix} exceeds "
+                f"dense_sketch_state_budget "
+                f"{config.dense_sketch_state_budget} and {reject}")
+        sparse = True
     if sparse:
-        # sort-based sparse path (SURVEY.md §8.4 #1): GroupBy only (the
-        # timeseries/topN assemblers index the dense bucket space)
-        if not isinstance(query, GroupByQuerySpec):
+        # sort-based sparse path (SURVEY.md §8.4 #1)
+        reject = _sparse_reject_reason(query, total, config)
+        if reject is not None:
             raise UnsupportedAggregation(
                 f"group space {total} exceeds dense budget "
-                f"{config.dense_group_budget} "
-                f"({query.query_type} has no sparse path)")
-        if total >= (1 << 62):
-            raise UnsupportedAggregation(
-                f"group space {total} overflows the int64 sparse key")
-        if not config.enable_x64:
-            raise UnsupportedAggregation(
-                "sparse group-by needs int64 keys (enable_x64=False)")
+                f"{config.dense_group_budget} and {reject}")
         # theta rides the sparse path with a clamped sketch width (the
         # [cap, k] table and its merge transients are per-group state;
         # see EngineConfig.sparse_theta_k_cap)
@@ -329,10 +364,8 @@ def _lower_agg(query, table, config) -> PhysicalPlan:
     if not sparse and not config.enable_x64:
         # sketch state is [groups × radix]; without 64-bit lanes the flat
         # scatter index must fit int32
-        from tpu_olap.kernels.hll import NUM_REGISTERS
         for p in agg_plans:
-            radix = NUM_REGISTERS if p.kind == "hll" else (
-                p.theta_k if p.kind == "theta" else 1)
+            radix = _radix(p)
             if radix > 1 and total * radix > (1 << 31) - 1:
                 raise UnsupportedAggregation(
                     f"sketch index space {total}×{radix} overflows int32 "

@@ -274,7 +274,10 @@ class QueryRunner:
                str(c.long_dtype), str(c.double_dtype),
                c.dense_group_budget, c.numeric_dim_label_budget,
                c.theta_k_cap, c.sparse_theta_k_cap, c.pallas_group_cap,
-               c.pallas_rows_per_block, c.pallas_k_per_block)
+               c.pallas_group_cap_factorized,
+               c.dense_sketch_state_budget,
+               c.pallas_rows_per_block, c.pallas_k_per_block,
+               c.pallas_auto_flop_budget)
         hit = self._plan_cache.get(key)
         if hit is not None and hit[0] is table:
             return hit[1]
@@ -1024,30 +1027,36 @@ class QueryRunner:
             partials = self._dispatch(
                 lambda: self._run_partials(plan, metrics), metrics,
                 table.name)
-            mask = np.asarray(partials["mask"]).reshape(
-                -1, table.block_rows)[:len(table.segments)]
-            # per-dimension masked value counts ON DEVICE in one extra
-            # jitted call (device-side scatter-adds over the resident
-            # code columns measure ~0.2 ms for all SSB dims at SF1; any
-            # host-side per-row pass costs seconds at this host's memory
-            # bandwidth), fetched as ONE packed vector
+            # per-dimension masked value counts over the stacked code
+            # columns, all dims packed into ONE result vector. On the
+            # device platform this is one extra jitted call (~0.2 ms of
+            # scatter-adds for all SSB dims at SF1) plus one mask
+            # round-trip (_run_partials materializes outputs to host;
+            # fusing the counts into the mask program itself would
+            # remove that transfer — future work). The numpy platform
+            # does the same bincounts in C. The dispatch mask may be
+            # padded past the segment stack (shard-multiple rounding) —
+            # slice, never the reverse (the kernels mask pruned
+            # segments in place rather than compacting them away)
             ds = self._dataset(table)
             cards = tuple(table.dictionaries[d].cardinality
                           for d in coded)
-            cols = tuple(ds.col(d) for d in coded)
+            pins = frozenset((table.name, "col", d) for d in coded)
+            cols = tuple(ds.col(d, pins) for d in coded)
+            n_flat = cols[0].size
             dev_mask = partials["mask"]
-            if dev_mask.size == cols[0].size:
-                packed = np.asarray(
-                    _search_counts_packed(cards, dev_mask, cols))
-            else:  # partial dispatch coverage: host fallback
-                flat_mask = mask.reshape(-1)
-                parts = []
-                for dim, card in zip(coded, cards):
-                    flat = np.concatenate(
-                        [s.columns[dim] for s in table.segments])
-                    parts.append(np.bincount(flat[flat_mask],
-                                             minlength=card + 1))
-                packed = np.concatenate(parts)
+            if dev_mask.size < n_flat:
+                raise AssertionError(
+                    "search mask shorter than the segment stack")
+            if self.config.platform == "cpu":
+                m = np.asarray(dev_mask).reshape(-1)[:n_flat]
+                packed = np.concatenate(
+                    [np.bincount(np.asarray(c).reshape(-1)[m],
+                                 minlength=card + 1)
+                     for c, card in zip(cols, cards)])
+            else:
+                packed = np.asarray(_search_counts_packed(
+                    cards, dev_mask.reshape(-1)[:n_flat], cols))
             off = 0
             for dim, card in zip(coded, cards):
                 d = table.dictionaries[dim]
