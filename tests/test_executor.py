@@ -301,3 +301,18 @@ def test_history_records(runner):
     rec = runner.history[-1]
     assert rec["query_type"] == "timeBoundary"
     assert "total_ms" in rec
+
+
+def test_search_padded_shard_mask():
+    """Search with num_shards not dividing the segment count: the
+    dispatch mask is padded past the segment stack and the count path
+    must slice it, never mis-map (5000 rows / 1024 block_rows = 5
+    segments, padded to 8 shards)."""
+    r8 = QueryRunner(EngineConfig(platform="device", num_shards=8))
+    q = SearchQuerySpec(
+        data_source="t", search_dimensions=("city",),
+        query=SearchQueryContains("am"),
+    )
+    res = r8.execute(q, TABLE)
+    counts = {h["value"]: h["count"] for h in res.rows}
+    assert counts["amsterdam"] == (DF.city == "amsterdam").sum()

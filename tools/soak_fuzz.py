@@ -121,6 +121,15 @@ def main():
         done += m
         print(f"[soak] {done}/{n} counts={counts}",
               file=sys.stderr, flush=True)
+        # incremental banking: a round boundary (or a crash) must not
+        # lose hours of soak evidence — the artifact reflects every
+        # completed chunk, seeds_completed recording partial coverage
+        out = _write(start, n, tag, chunk, counts, failures, done, t0)
+    print(json.dumps({"counts": counts, "wall_s": out["wall_s"]}))
+    return 1 if failures else 0
+
+
+def _write(start, n, tag, chunk, counts, failures, done, t0):
     out = {
         "seed_start": start, "n": n,
         "seed_derivation": "default_rng(1000 + seed), CI-identical",
@@ -128,11 +137,11 @@ def main():
         "chunk_seeds_per_process": chunk,
         "wall_s": round(time.time() - t0, 1),
         "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "seeds_completed": done,
     }
     with open(os.path.join(REPO, f"SOAK_{tag}.json"), "w") as f:
         json.dump(out, f, indent=1)
-    print(json.dumps({"counts": counts, "wall_s": out["wall_s"]}))
-    return 1 if failures else 0
+    return out
 
 
 if __name__ == "__main__":
