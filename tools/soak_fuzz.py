@@ -78,6 +78,12 @@ def main():
     t0 = time.time()
 
     if os.environ.get("SOAK_INLINE"):
+        # hard address-space cap: a pathological seed must surface as a
+        # caught per-seed MemoryError in the artifact, not grind the
+        # host into swap and an OOM kill that voids the whole chunk
+        import resource
+        cap = int(float(os.environ.get("SOAK_RLIMIT_GB", 40)) * 2**30)
+        resource.setrlimit(resource.RLIMIT_AS, (cap, cap))
         counts, failures = _run_range(start, n)
         print(json.dumps({"counts": counts, "failures": failures}))
         return 1 if failures else 0
@@ -90,6 +96,7 @@ def main():
     counts = {"ok": 0, "fallback": 0, "fail": 0, "error": 0}
     failures = []
     done = 0
+    out = _write(start, n, tag, chunk, counts, failures, done, t0)
     while done < n:
         m = min(chunk, n - done)
         env = dict(os.environ)

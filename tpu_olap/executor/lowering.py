@@ -122,6 +122,14 @@ def _sparse_reject_reason(query, total, config) -> str | None:
     return None
 
 
+def _mesh_size(config) -> int:
+    """Devices the runner will shard over. QueryRunner builds a mesh
+    ONLY when num_shards > 1 is explicitly configured (runner.mesh);
+    unsharded runs must not have their sketch state budgeted at
+    device-count multiples they never allocate."""
+    return int(config.num_shards) if config.num_shards else 1
+
+
 def _radix(p) -> int:
     """Per-group state width of an aggregation plan: HLL register file,
     theta value table, or 1 for scalar accumulators. Shared by the
@@ -330,10 +338,18 @@ def _lower_agg(query, table, config) -> PhysicalPlan:
     # sketch aggregates keep [groups × radix] state PER AGGREGATION: at
     # large K their TOTAL dominates memory long before the group COUNT
     # exceeds the dense budget (observed: a 1M-group theta query
-    # allocating >100 GB). Budget the summed state element count — over
-    # budget, the sparse path (clamped sketch width) serves it when it
+    # allocating >100 GB). Theta's mesh merge additionally all_gathers
+    # [D, K, k] per device (executor/sharding.py::merge_collective), so
+    # its state multiplies by the mesh size — a fuzz-found sharded
+    # theta query ground a host to 100 GB and an XLA rendezvous abort
+    # with per-sketch state that looked safe unscaled. Budget the
+    # summed, mesh-scaled element count — over budget, the sparse path
+    # (clamped sketch width, all_to_all exchange) serves it when it
     # can; shapes with no sparse path decline legibly, never allocate
-    state_radix = sum(_radix(p) for p in agg_plans if _radix(p) > 1)
+    theta_radix = sum(p.theta_k for p in agg_plans if p.kind == "theta")
+    other_radix = sum(_radix(p) for p in agg_plans
+                      if p.kind != "theta" and _radix(p) > 1)
+    state_radix = other_radix + theta_radix * max(1, _mesh_size(config))
     sketch_over = (state_radix > 0
                    and total * state_radix
                    > config.dense_sketch_state_budget)

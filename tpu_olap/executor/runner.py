@@ -272,6 +272,7 @@ class QueryRunner:
                _json.dumps(query.to_json(), sort_keys=True, default=str),
                c.use_pallas, c.platform, c.enable_x64,
                str(c.long_dtype), str(c.double_dtype),
+               c.num_shards,
                c.dense_group_budget, c.numeric_dim_label_budget,
                c.theta_k_cap, c.sparse_theta_k_cap, c.pallas_group_cap,
                c.pallas_group_cap_factorized,
