@@ -363,6 +363,70 @@ def test_pallas_factorized_boundary_sweep():
     assert factorization(1001, 9, 0, cfg) is not None
 
 
+def test_pallas_plane_sizing():
+    """Round-5 roofline fix: byte planes sized by the column value span,
+    not a fixed 32 bits. A 14-bit span costs 2 planes; a negative span
+    biases; a wide positive lo biases only when it saves net columns."""
+    from tpu_olap.kernels.pallas_reduce import _sum_plane_spec
+
+    assert _sum_plane_spec(0, 10_000) == (2, 0)
+    assert _sum_plane_spec(0, 255) == (1, 0)
+    assert _sum_plane_spec(0, 2**31 - 1) == (4, 0)
+    # mandatory bias for negative lo
+    n, bias = _sum_plane_spec(-500, 500)
+    assert bias == -500 and n == 2
+    # lo = 2**24: unbiased needs 4 planes, biased needs 1 + the extra
+    # row-count column = cheaper
+    n, bias = _sum_plane_spec(2**24, 2**24 + 100)
+    assert (n, bias) == (1, 2**24)
+    # narrow saving: biasing 0..255 span at lo=256 would cost 1+1 vs 2
+    assert _sum_plane_spec(256, 511) == (2, 0)
+
+
+def test_pallas_chunked_accumulator():
+    """Grid runs longer than steps_per_chunk flush one accumulator chunk
+    per run; the host recombines chunks in f64. Forced here by shrinking
+    MAX_VALUE so spc drops to 2 grid steps (production: ~8M rows)."""
+    from tpu_olap.kernels import pallas_reduce
+
+    old = pallas_reduce.MAX_VALUE
+    pallas_reduce.MAX_VALUE = 256 * 255 * 2 + 1  # spc = 2 at rb = 256
+    try:
+        rng = np.random.default_rng(47)
+        n = 8192
+        df = pd.DataFrame({
+            "ts": pd.to_datetime("2023-01-01")
+            + pd.to_timedelta(rng.integers(0, 86400 * 20, n), unit="s"),
+            "gch": rng.choice([f"c{i}" for i in range(11)], n),
+            "v": rng.integers(-200, 200, n).astype(np.int64),
+            "w": rng.integers(0, 101, n).astype(np.int64),
+        })
+        df.loc[rng.random(n) < 0.04, "w"] = np.nan
+        df["w"] = df["w"].astype("Int64")
+        plain = Engine(EngineConfig(use_pallas="never"))
+        forced = Engine(EngineConfig(use_pallas="force"))
+        for e in (plain, forced):
+            e.register_table("ch_t", df, time_column="ts", block_rows=256)
+        # 8192 rows / rb 256 = 32 grid steps = 16 chunks; cover biased
+        # sums, nullable inputs, filtered aggs, counts, and min/max
+        # (unchunked second buffer) in one layout
+        for q in (
+            """SELECT gch, sum(v) AS s, count(*) AS n,
+                      sum(w) FILTER (WHERE v > 0) AS sw
+               FROM ch_t GROUP BY gch ORDER BY gch""",
+            """SELECT gch, min(v) AS mn, max(v) AS mx, sum(w) AS sw
+               FROM ch_t GROUP BY gch ORDER BY gch""",
+            "SELECT sum(v * w) AS sv FROM ch_t",
+        ):
+            a, b = plain.sql(q), forced.sql(q)
+            plan = forced.planner.plan(q)
+            phys = lower(plan.query, plan.entry.segments, forced.config)
+            assert phys.pallas_reason is None, phys.pallas_reason
+            pd.testing.assert_frame_equal(a, b)
+    finally:
+        pallas_reduce.MAX_VALUE = old
+
+
 def test_pallas_factorized_beyond_direct_cap():
     """Group spaces past pallas_group_cap stay on the kernel when the
     layout factorizes (pallas_group_cap_factorized); min/max layouts

@@ -8,20 +8,29 @@ inputs — and fuses the whole per-row pipeline (validity/filter masks,
 mixed-radix key build, virtual-column arithmetic, half-plane decomposition)
 into one pass over VMEM-resident row chunks.
 
-Exact int64 sums via fixed-point half-planes
+Exact int64 sums via fixed-point byte planes
 --------------------------------------------
 The MXU has no integer matmul wide enough for longSum semantics, and f32
 accumulation is only exact below 2^24. Each int32 aggregate input v >= 0 is
-decomposed into 4-bit planes  v = sum_j h_j * 16^j  (h_j in [0, 15], exact
-in bf16). Per grid step the kernel computes
+decomposed into 8-bit planes  v = sum_j h_j * 256^j  (h_j in [0, 255] —
+exact in bf16, whose 8 mantissa bits represent every integer up to 256).
+The plane COUNT is sized per query from the column-metadata value span
+(round-5 roofline fix: the round-4 kernel burned a fixed 8 planes of 4
+bits on every sum; byte planes + span sizing cut the accumulator lane
+count 2-4x and the one-hot FLOPs with it). Per grid step the kernel
+computes
 
     partial[K, H] = onehotT[K, RB] . valsT[H, RB]^T      (bf16 x bf16 -> f32)
 
-whose entries are integer-valued and bounded by RB * 15 < 2^24, so the f32
-result is exact; it is then cast to int32 and accumulated across grid steps
-in the int32 output, exact while N_rows_per_chip * 15 < 2^31 (~143M rows —
-an eligibility condition). Host-side, planes recombine in int64:
-sum_j out[:, j] << 4j. Counts ride the same matmul as columns of ones.
+whose entries are integer-valued and bounded by RB * 255 < 2^24, so the
+f32 result is exact; it is cast to int32 and accumulated across grid
+steps in the int32 output. Accumulation overflow is handled by a CHUNK
+axis instead of an eligibility row cap: the output carries one [K, H]
+buffer per run of `steps_per_chunk` grid steps (sized so each chunk's
+accumulated plane sums stay under 2^31), and the host recombines chunks
+with an exact f64 sum (each chunk value < 2^31, totals < 2^53). Planes
+then recombine as sum_j out[:, j] << 8j in two f64 half-sums. Counts
+ride the same matmul as columns of ones.
 
 Eligibility (checked by `eligible()`, anything else falls back to the XLA
 scatter path — mirroring the planner's structural-fallback rule, SURVEY.md
@@ -62,10 +71,13 @@ from tpu_olap.ir.expr import BinOp, Col, Lit
 from tpu_olap.kernels.exprs import materialize_virtuals
 from tpu_olap.segments.segment import ColumnType, TIME_COLUMN
 
-N_PLANE_BITS = 4
+N_PLANE_BITS = 8
 PLANE_MASK = (1 << N_PLANE_BITS) - 1
 MAX_VALUE = (1 << 31) - 1           # aggregate inputs must fit int32
-MAX_ROWS = MAX_VALUE // PLANE_MASK  # int32 accumulator headroom per chip
+# The chunked accumulator removes the int32 per-chip row cap; what
+# remains is f64 exactness of the host-side half-sum recombination:
+# each half-sum is below n_rows * 255 * 257 and must stay under 2^53.
+MAX_ROWS = (1 << 53) // (PLANE_MASK * (PLANE_MASK + 2))
 
 
 def expr_int_bounds(expr, col_bounds):
@@ -378,6 +390,23 @@ class PallasLayout:
     n_minmax: int = 0             # columns of the second output buffer
 
 
+def _sum_plane_spec(lo: int, hi: int) -> tuple:
+    """(n_planes, bias) for a sum whose inputs lie in [lo, hi]: the
+    minimal byte-plane count covering the value span. bias != 0 shifts
+    inputs into [0, hi - lo] (mandatory for lo < 0, since planes are
+    unsigned); a non-negative range is biased only when the shift saves
+    more planes than the one extra row-count column the un-shift needs."""
+    def planes(top):
+        return max(1, -(-max(int(top), 1).bit_length() // N_PLANE_BITS))
+
+    shifted = planes(hi - lo)
+    if lo < 0:
+        return shifted, lo
+    if shifted + 1 < planes(hi):
+        return shifted, lo
+    return planes(hi), 0
+
+
 def plan_layout(agg_plans, sum_bounds) -> PallasLayout:
     slots = []
     h = 1  # slot 0: _rows
@@ -393,9 +422,7 @@ def plan_layout(agg_plans, sum_bounds) -> PallasLayout:
             h += 1
             n_mm += 1
         else:  # sum
-            n = -(-32 // N_PLANE_BITS)
-            lo = sum_bounds[p.name][0]
-            bias = lo if lo < 0 else 0
+            n, bias = _sum_plane_spec(*sum_bounds[p.name])
             slots.append((p.name, "sum", h, n, bias))
             h += n + (1 if bias else 0)
     return PallasLayout(h, 0, tuple(slots), n_minmax=n_mm)
@@ -442,8 +469,13 @@ def eligible(query, plan, table, config, filter_fn=None) -> str | None:
     if table.block_rows % rb != 0:
         return (f"pallas_rows_per_block {rb} does not divide block_rows "
                 f"{table.block_rows}")
+    if rb * PLANE_MASK >= 1 << 24:
+        # per-step f32 matmul partials must stay exact: byte planes bound
+        # each lane's per-row worth at 255, so rb caps at 65792
+        return f"rows-per-block {rb} breaks f32 plane-sum exactness"
     if table.num_rows > MAX_ROWS:
-        return f"row count {table.num_rows} exceeds int32 headroom"
+        return (f"row count {table.num_rows} exceeds f64 recombination "
+                "headroom")
     for dp in plan.dim_plans:
         if dp.kind not in ("codes", "numeric", "remap", "timeformat"):
             return f"dimension kind {dp.kind!r}"
@@ -544,6 +576,16 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
         + ["\0d:" + t for t, _, _ in plan.filter_streams]
     n_mm = layout.n_minmax
     MM_pad = max(128, -(-n_mm // 128) * 128) if n_mm else 0
+    # Chunked accumulation: the int32 output accumulates per-step f32
+    # partials whose per-row worth is PLANE_MASK (byte planes) or 1
+    # (count-only layouts). Every `spc` grid steps the output block index
+    # advances, flushing a fresh [KB, W] chunk, so per-chunk sums stay
+    # under 2^31 for ANY per-chip row count; the host recombines chunks
+    # with an exact f64 sum. spc is static (rb is), n_chunks is shape-
+    # derived inside fn.
+    per_row = PLANE_MASK if any(
+        s[1] == "sum" for s in layout.agg_slots) else 1
+    spc = max(1, MAX_VALUE // (rb * per_row))
 
     def make_kernel_fn(null_names):
         def kernel_fn(*refs):
@@ -668,10 +710,10 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
                 onehot, vals, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32).astype(jnp.int32)
 
-            @pl.when(step == 0)
+            @pl.when(step % spc == 0)  # first step of this chunk
             def _():
-                out_ref[:, :] = jnp.zeros((KB, W), jnp.int32)
-            out_ref[:, :] += partial
+                out_ref[0, :, :] = jnp.zeros((KB, W), jnp.int32)
+            out_ref[0, :, :] += partial
 
             if mm_ref is not None:
                 pad = MM_pad - len(mm_cols)
@@ -734,9 +776,14 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
         const_in = [_narrow(jnp.asarray(consts[c]).reshape(1, -1), jnp)
                     for c in const_names]
 
-        out_specs = pl.BlockSpec((KB, W), lambda kb, i: (kb, _z))
-        out_shape = jax.ShapeDtypeStruct((K_pad, W), jnp.int32)
+        n_chunks = -(-grid_rows // spc)
+        _spc = np.int32(spc)
+        out_specs = pl.BlockSpec((1, KB, W),
+                                 lambda kb, i: (i // _spc, kb, _z))
+        out_shape = jax.ShapeDtypeStruct((n_chunks, K_pad, W), jnp.int32)
         if n_mm:
+            # the min/max VPU buffer accumulates a minimum — no overflow,
+            # so it stays unchunked (one block per K-block, all steps)
             out_specs = [out_specs,
                          pl.BlockSpec((KB, MM_pad), lambda kb, i: (kb, _z))]
             out_shape = [out_shape,
@@ -757,6 +804,13 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
         if n_mm:
             out, mm = out
             mm = mm[:K]
+        if n_chunks > 1:
+            # exact: each chunk entry < 2^31, chunk totals < 2^53 (the
+            # MAX_ROWS eligibility bound); f64 keeps the consumer out of
+            # the fused int pipeline (see the recombination note below)
+            out = out.astype(jnp.float64).sum(axis=0)
+        else:
+            out = out[0]
         if fact is not None:
             # entry (k1, h*k2 + k2v) -> row k1*k2 + k2v == dense key,
             # column h: plain XLA reshuffle outside the pallas_call
@@ -788,12 +842,13 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
                 # correct, and multiplies instead of shifts change
                 # nothing). f64 math forces the consumer out of the fused
                 # int pipeline and is exact here: each half-sum is below
-                # 15*MAX_ROWS*(16^3+16^2+16+1) < 2^53.
-                half = n_planes // 2  # planes [0, half) and [half, 8)
+                # 255*MAX_ROWS*(256+1) < 2^53 (the MAX_ROWS bound).
+                half = (n_planes + 1) // 2  # [0, half) and [half, n)
                 lo = jnp.zeros((K,), jnp.float64)
                 hi = jnp.zeros((K,), jnp.float64)
                 for j in range(n_planes):
-                    w = float(1 << (N_PLANE_BITS * (j % half)))
+                    w = float(1 << (N_PLANE_BITS *
+                                    (j if j < half else j - half)))
                     v = out[:, start + j].astype(jnp.float64) * w
                     if j < half:
                         lo = lo + v
@@ -803,13 +858,16 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
                     hi.astype(jnp.int64) << (N_PLANE_BITS * half))
                 if bias:
                     # same split for the bias un-shift: bias*n can exceed
-                    # 2^53, so do it in 16-bit halves of |bias|
+                    # 2^53, so do it in 16-bit halves of |bias|. True sum
+                    # = plane sum + n_masked * bias (inputs were shifted
+                    # by -bias), so the adjustment adds for bias > 0 and
+                    # subtracts for bias < 0.
                     n_masked = out[:, start + n_planes].astype(jnp.float64)
-                    b = -bias  # bias < 0: inputs were shifted by -bias
+                    b = abs(bias)
                     b_lo, b_hi = b & 0xFFFF, b >> 16
-                    sub = (n_masked * float(b_lo)).astype(jnp.int64) + (
+                    adj = (n_masked * float(b_lo)).astype(jnp.int64) + (
                         (n_masked * float(b_hi)).astype(jnp.int64) << 16)
-                    acc = acc - sub
+                    acc = acc + adj if bias > 0 else acc - adj
                 res[name] = acc.astype(p.acc_dtype)
         return res
 
