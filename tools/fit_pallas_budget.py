@@ -43,9 +43,14 @@ NOISE = 1.15  # auto must be >15% slower before a query counts as a loss
 
 
 def main():
+    # A/B input artifacts must come from the SAME kernel code the fit
+    # will tune (overridable so each round's probe names its own pair)
     paths = {n: os.path.join(REPO, f)
-             for n, f in (("auto", "BENCH_TPU_AUTO_r04.json"),
-                          ("never", "BENCH_TPU_PALLAS_never.json"))}
+             for n, f in (
+                 ("auto", os.environ.get("FIT_AUTO_JSON",
+                                         "BENCH_TPU_AUTO_r04.json")),
+                 ("never", os.environ.get("FIT_NEVER_JSON",
+                                          "BENCH_TPU_PALLAS_never.json")))}
     runs = {}
     for name, p in paths.items():
         if not os.path.exists(p):

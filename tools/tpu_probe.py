@@ -35,7 +35,9 @@ def log(rec):
         f.write(json.dumps(rec) + "\n")
 
 
-def attempt_bench(use_pallas: str | None = None, rows: int | None = None):
+def attempt_bench(use_pallas: str | None = None, rows: int | None = None,
+                  extra_env: dict | None = None,
+                  timeout: float | None = None):
     """Run bench.py on the default backend. Returns (status, rec|None):
     status in {"tpu", "cpu", "timeout", "error"}."""
     env = dict(os.environ)
@@ -48,11 +50,12 @@ def attempt_bench(use_pallas: str | None = None, rows: int | None = None):
         env.setdefault("SSB_ROWS", "6000000")
     if use_pallas is not None:
         env["SSB_USE_PALLAS"] = use_pallas
+    env.update(extra_env or {})
     try:
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py")],
-            timeout=ATTEMPT_TIMEOUT, capture_output=True, text=True,
-            env=env, cwd=REPO)
+            timeout=timeout or ATTEMPT_TIMEOUT, capture_output=True,
+            text=True, env=env, cwd=REPO)
     except subprocess.TimeoutExpired as e:
         tail = ""
         if e.stderr:
@@ -107,23 +110,7 @@ def attempt_cmd(argv, extra_env=None, timeout=None):
     return "ok", None
 
 
-def _calibrated_tpu():
-    path = os.path.join(REPO, "tpu_olap", "planner",
-                        "cost_calibration.json")
-    try:
-        with open(path) as f:
-            return "tpu" in json.load(f)
-    except Exception:  # noqa: BLE001
-        return False
-
-
-# The window plan (VERDICT r3 task #1/#5/#6/#10), in priority order: the
-# Pallas A/B first (the banked auto run IS the Pallas leg on TPU), then
-# the per-query profile that explains the 69 ms floor and the 3x grouped
-# outliers, then the min/max+remap hardware validation, then the TPU cost
-# fit, and the SF10 scale proof last (slowest; dataset pre-generated under
-# .ssb_data so the window is spent ingesting + querying, not writing
-# parquet). Each leg is (event, done() predicate, run() thunk).
+# Each leg is (event, done() predicate, run() thunk).
 def _bench_leg(fname, **kw):
     def run():
         s, rec = attempt_bench(**kw)
@@ -139,53 +126,69 @@ def _file_done(fname):
     return lambda: os.path.exists(os.path.join(REPO, fname))
 
 
-def _pallas_validation_done():
-    """Banked only when the suite ran CLEAN: a tunnel drop mid-suite
-    records transport errors as failures, and that artifact must not
-    mask a retry in the next window (a genuinely-failing suite stops
-    retrying via MAX_LEG_FAILURES and its last artifact stays banked)."""
-    path = os.path.join(REPO, "PALLAS_TPU_VALIDATION.json")
-    try:
-        with open(path) as f:
-            return json.load(f).get("failed") == 0
-    except Exception:  # noqa: BLE001
-        return False
+_PROBE_START = time.time()
 
 
+def _fresh_done(fname, check=None):
+    """Leg done when the artifact was (re)written by THIS probe run —
+    round-5 legs rewrite round-4 artifacts in place (old content is in
+    git), so existence alone cannot mean done."""
+    path = os.path.join(REPO, fname)
+
+    def done():
+        try:
+            if os.path.getmtime(path) < _PROBE_START:
+                return False
+            if check is not None:
+                with open(path) as f:
+                    return check(json.load(f))
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+    return done
+
+
+# Round-5 window plan (VERDICT r4 tasks #1-#3), in priority order for a
+# possibly-short window: hardware-validate the byte-plane/chunked kernel
+# first (interpret mode cannot catch Mosaic lowering regressions: the
+# 3-D chunked output block, step%spc init, i//spc index maps), then the
+# fresh SF1 auto bench + per-query profile (the after-trace of the
+# roofline fix), then the scale proofs SF10 -> SF20 (the <=60 ms
+# over-floor target) -> SF100-on-one-chip eviction churn (dataset
+# pre-generated on the host so the window is ingest+queries only).
 EXTRA_LEGS = [
-    # fresh auto run with THIS round's code (derived streams resident):
-    # the A/B pair must not straddle the round-3/round-4 code boundary
-    ("auto bench r04", _file_done("BENCH_TPU_AUTO_r04.json"),
-     _bench_leg("BENCH_TPU_AUTO_r04.json")),
-    ("pallas-never bench", _file_done("BENCH_TPU_PALLAS_never.json"),
-     _bench_leg("BENCH_TPU_PALLAS_never.json", use_pallas="never")),
-    ("fit pallas budget",
-     _file_done(os.path.join("tpu_olap", "planner",
-                             "pallas_tuning.json")),
-     lambda: attempt_cmd(["tools/fit_pallas_budget.py"], timeout=600)),
-    ("per-query profile", _file_done("PROFILE_TPU.json"),
-     lambda: attempt_cmd(["tools/profile_tpu.py"])),
-    ("pallas hw validation", _pallas_validation_done,
+    ("pallas hw validation r05",
+     _fresh_done("PALLAS_TPU_VALIDATION.json",
+                 lambda d: d.get("failed") == 0),
      lambda: attempt_cmd(["tools/validate_pallas_tpu.py"])),
-    ("tpu cost calibration", _calibrated_tpu,
-     lambda: attempt_cmd(["tools/calibrate_cost.py"],
-                         {"CAL_REQUIRE_TPU": "1"})),
-    ("sf10 bench", _file_done("BENCH_TPU_SF10.json"),
-     _bench_leg("BENCH_TPU_SF10.json", rows=60_000_000)),
-    # round-4 addition after the first window's findings: tiling/sparse
-    # sweep for the grouped outliers. (The per-query profile leg above
-    # re-banks PROFILE_TPU.json automatically under the corrected
-    # two-regime tuning — the first capture, renamed
-    # PROFILE_TPU_SCATTER.json, caught every grouped query on the
-    # scatter path because the inverted first fit routed them there.)
-    ("pallas tiling sweep", _file_done("PALLAS_SWEEP_TPU.json"),
-     lambda: attempt_cmd(["tools/sweep_pallas_tpu.py"])),
-    # second-window additions: the SF20 single-chip over-proof (1.6x the
-    # SF100/v5e-8 per-chip row load, exercises HBM eviction) — dataset
-    # cached under .ssb_data by the first run, so a re-bank spends the
-    # window on ingest+queries only
-    ("sf20 bench", _file_done("BENCH_TPU_SF20.json"),
-     _bench_leg("BENCH_TPU_SF20.json", rows=120_000_000)),
+    ("auto bench r05", _file_done("BENCH_TPU_AUTO_r05.json"),
+     _bench_leg("BENCH_TPU_AUTO_r05.json")),
+    ("per-query profile r05", _fresh_done("PROFILE_TPU.json"),
+     lambda: attempt_cmd(["tools/profile_tpu.py"])),
+    # the A/B pair must not straddle the round-4/round-5 kernel boundary:
+    # refit the auto policy only from THIS round's pair
+    ("pallas-never bench r05",
+     _file_done("BENCH_TPU_PALLAS_never_r05.json"),
+     _bench_leg("BENCH_TPU_PALLAS_never_r05.json", use_pallas="never")),
+    ("fit pallas budget r05",
+     _fresh_done(os.path.join("tpu_olap", "planner",
+                              "pallas_tuning.json")),
+     lambda: attempt_cmd(
+         ["tools/fit_pallas_budget.py"],
+         {"FIT_AUTO_JSON": "BENCH_TPU_AUTO_r05.json",
+          "FIT_NEVER_JSON": "BENCH_TPU_PALLAS_never_r05.json"},
+         timeout=900)),
+    ("sf10 bench r05", _file_done("BENCH_TPU_SF10_r05.json"),
+     _bench_leg("BENCH_TPU_SF10_r05.json", rows=60_000_000)),
+    ("sf20 bench r05", _file_done("BENCH_TPU_SF20_r05.json"),
+     _bench_leg("BENCH_TPU_SF20_r05.json", rows=120_000_000)),
+    ("sf100 1-chip bench", _file_done("BENCH_TPU_SF100_1CHIP.json"),
+     _bench_leg("BENCH_TPU_SF100_1CHIP.json", rows=600_000_000,
+                extra_env={"BENCH_RESULT_DIGEST": "1",
+                           "BENCH_RAM_CAP_GB": "64",
+                           "BENCH_HBM_BUDGET_BYTES": str(12 * 2**30),
+                           "BENCH_ITERS": "3"},
+                timeout=7200)),
 ]
 MAX_LEG_FAILURES = 2  # deterministic failures must not eat the window
 
