@@ -547,14 +547,32 @@ def test_correlated_subquery_executes():
     assert got["n"][0] == int((df["v"] > df["g"].map(avg)).sum())
 
 
-def test_correlated_subquery_unsupported_shape_rejected_clearly():
-    """Correlation shapes outside the equality class keep the legible
-    rejection (never a silent wrong answer)."""
+def test_correlated_subquery_beyond_rewrite_nested_loop():
+    """Correlation shapes outside the magic-set rewrite run the bounded
+    nested loop (round 5, VERDICT r4 missing #2) — correct-but-slow, not
+    an error; past corr_nested_loop_cap the refusal stays legible."""
+    from tpu_olap.executor import EngineConfig
     from tpu_olap.planner.fallback import FallbackError
-    eng, _ = _engine()
-    with pytest.raises(FallbackError, match="correlated"):
-        eng.sql("SELECT count(*) AS n FROM t "
-                "WHERE v > (SELECT avg(t2.v) FROM t t2 WHERE t2.v < t.v)")
+    eng, df = _engine()
+    got = eng.sql("SELECT count(*) AS n FROM t "
+                  "WHERE v > (SELECT avg(t2.v) FROM t t2 "
+                  "WHERE t2.v < t.v)")
+
+    def avg_below(v):
+        c = df[df["v"] < v]["v"]
+        return None if c.empty else c.sum() / len(c)
+
+    exp = sum(1 for v in df["v"]
+              if avg_below(v) is not None and v > avg_below(v))
+    assert int(got["n"].iloc[0]) == exp
+
+    # past the cap the refusal is still legible, never a wrong answer
+    eng2 = Engine(EngineConfig(corr_nested_loop_cap=2))
+    eng2.register_table("t", df, time_column="ts")
+    with pytest.raises(FallbackError, match="corr_nested_loop_cap"):
+        eng2.sql("SELECT count(*) AS n FROM t "
+                 "WHERE v > (SELECT avg(t2.v) FROM t t2 "
+                 "WHERE t2.v < t.v)")
 
 
 def test_case_folding_extraction_dims():

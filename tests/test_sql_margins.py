@@ -328,12 +328,19 @@ def test_lag_lead_window(eng):
     assert z["z"].tolist() == [10, 20, 30, 40, 50]
 
 
-def test_non_equality_correlation_still_legible(eng):
-    e, _, _ = eng
-    with pytest.raises(Exception, match="correlat|not supported"):
-        e.sql("""SELECT count(*) AS n FROM fact
-                 WHERE v > (SELECT avg(f2.v) FROM fact f2
-                            WHERE f2.k > fact.k)""")
+def test_non_equality_correlated_scalar_nested_loop(eng):
+    """Comparison-correlated scalar aggregate: beyond the magic-set
+    rewrite, served by the bounded nested loop (round 5)."""
+    e, fact, _ = eng
+    got = e.sql("""SELECT count(*) AS n FROM fact
+                   WHERE v > (SELECT avg(f2.v) FROM fact f2
+                              WHERE f2.k > fact.k)""")
+    def avg_above(k):
+        c = fact[fact["k"] > k]["v"]
+        return None if c.empty else c.sum() / len(c)
+    exp = sum(1 for r in fact.itertuples()
+              if avg_above(r.k) is not None and r.v > avg_above(r.k))
+    assert int(got["n"].iloc[0]) == exp
 
 
 def test_derived_table_in_join(eng):
@@ -568,11 +575,14 @@ def test_comparison_correlated_exists(eng):
         "SELECT count(*) AS n FROM fact f1 WHERE EXISTS "
         "(SELECT 1 FROM fact f2 WHERE f2.v > f1.v)")
     assert int(got3["n"].iloc[0]) == int((fact.v < fact.v.max()).sum())
-    # two comparison conjuncts cannot be witnessed by min/max: legible
-    with pytest.raises(Exception, match="one comparison"):
-        e.sql("SELECT count(*) FROM fact f1 WHERE EXISTS "
-              "(SELECT 1 FROM fact f2 WHERE f2.v > f1.v AND "
-              "f2.k < f1.k)")
+    # two comparison conjuncts cannot be witnessed by min/max — the
+    # bounded nested loop serves them instead (round 5, VERDICT r4 #2)
+    got4 = e.sql("SELECT count(*) AS n FROM fact f1 WHERE EXISTS "
+                 "(SELECT 1 FROM fact f2 WHERE f2.v > f1.v AND "
+                 "f2.k < f1.k)")
+    exp4 = sum(1 for r in fact.itertuples()
+               if ((fact.v > r.v) & (fact.k < r.k)).any())
+    assert int(got4["n"].iloc[0]) == exp4
 
 
 def test_window_over_groups_nested_scopes(eng):
@@ -610,3 +620,174 @@ def test_interval_commuted_and_rejections(eng):
     with pytest.raises(Exception, match="frame"):
         e.sql("SELECT sum(v) OVER (ORDER BY ts ROWS BETWEEN CURRENT "
               "ROW AND UNBOUNDED PRECEDING) FROM fact")
+
+
+def _norm(df):
+    """Order- and dtype-insensitive frame normalization for union parity:
+    grouping-set unions only promise row MULTISET equality (+ ORDER BY
+    where spelled), and NULL key columns are object-typed on the union
+    path vs whatever pandas inferred on the fallback path."""
+    out = df.astype(object).where(df.notna(), None)
+    return sorted(map(tuple, out.to_numpy().tolist()),
+                  key=lambda t: tuple(str(x) for x in t))
+
+
+GSET_QUERIES = [
+    "SELECT grp, k, sum(v) AS s, count(*) AS n FROM fact "
+    "GROUP BY ROLLUP(grp, k)",
+    "SELECT grp, k, sum(v) AS s FROM fact GROUP BY CUBE(grp, k)",
+    "SELECT grp, k, count(*) AS n FROM fact "
+    "GROUP BY GROUPING SETS ((grp), (k), ())",
+    "SELECT grp, GROUPING(grp) AS gg, sum(v) AS s FROM fact "
+    "GROUP BY ROLLUP(grp) ORDER BY gg, grp",
+    "SELECT grp, k, sum(v) AS s FROM fact "
+    "GROUP BY GROUPING SETS ((grp, k), ()) ORDER BY s DESC LIMIT 7",
+]
+
+
+@pytest.mark.parametrize("sql", GSET_QUERIES)
+def test_grouping_sets_device_union_parity(eng, sql):
+    """VERDICT r4 missing #4: GROUPING SETS/ROLLUP/CUBE execute as a
+    union of per-set GROUP BY dispatches on the DEVICE path, with exact
+    multiset parity vs the whole-statement fallback."""
+    e, fact, dim = eng
+    got = e.sql(sql)
+    plan = e.last_plan
+    legs = getattr(plan, "grouping_legs", None)
+    assert legs, "grouping-sets union path did not engage"
+    assert all(lp.rewritten for lp in legs), \
+        [lp.fallback_reason for lp in legs]
+    # whole-statement fallback oracle on an unaccelerated twin
+    e2 = Engine()
+    e2.register_table("fact", fact, time_column="ts", accelerate=False)
+    want = e2.sql(sql)
+    assert list(got.columns) == list(want.columns)
+    assert _norm(got) == _norm(want)
+    if "ORDER BY" in sql and "LIMIT" not in sql:
+        # spelled ordering must hold exactly, not just as a multiset
+        key = got.columns[got.columns.get_loc("gg")] \
+            if "gg" in got.columns else None
+        if key is not None:
+            assert got["gg"].tolist() == want["gg"].tolist()
+
+
+def test_grouping_sets_pure_dimension_projection(eng):
+    """A set whose projections all fold to constants (the () leg of a
+    GROUPING()-only SELECT) must still contribute its rows — one per
+    group of that set — via the hidden count probe."""
+    e, fact, _ = eng
+    sql = ("SELECT grp, GROUPING(grp) AS gg FROM fact "
+           "GROUP BY ROLLUP(grp) ORDER BY gg, grp")
+    got = e.sql(sql)
+    assert getattr(e.last_plan, "grouping_legs", None)
+    e2 = Engine()
+    e2.register_table("fact", fact, time_column="ts", accelerate=False)
+    want = e2.sql(sql)
+    assert getattr(e2.last_plan, "grouping_legs", None) is None, \
+        "oracle must not take the union path"
+    assert _norm(got) == _norm(want)
+    assert len(got) == fact["grp"].nunique() + 1
+    # per-group multiplicity: a (k) set with constant projections emits
+    # one row per k group
+    sql2 = ("SELECT grp, GROUPING(grp) AS gg FROM fact "
+            "GROUP BY GROUPING SETS ((grp), (k))")
+    got2 = e.sql(sql2)
+    want2 = e2.sql(sql2)
+    assert _norm(got2) == _norm(want2)
+    assert len(got2) == fact["grp"].nunique() + fact["k"].nunique()
+
+
+def test_grouping_sets_union_leg_fallback_still_correct(eng):
+    """Legs the device path cannot serve (e.g. the grand-total () leg
+    with HAVING: a K=1 aggregate with HAVING is a known device decline)
+    fall back alone; the union stays correct and the grouped legs still
+    ride the device path."""
+    e, fact, _ = eng
+    for sql, min_dev in (
+        ("SELECT grp, count(*) AS n FROM fact "
+         "GROUP BY GROUPING SETS ((grp), ()) HAVING count(*) > 0", 1),
+        ("SELECT grp, k, sum(v) AS s FROM fact "
+         "GROUP BY ROLLUP(grp, k) HAVING count(*) > 5", 2),
+    ):
+        got = e.sql(sql)
+        legs = getattr(e.last_plan, "grouping_legs", None)
+        assert legs, "union path did not engage"
+        assert sum(1 for lp in legs if lp.rewritten) >= min_dev, \
+            [lp.fallback_reason for lp in legs]
+        e2 = Engine()
+        e2.register_table("fact", fact, time_column="ts",
+                          accelerate=False)
+        want = e2.sql(sql)
+        assert _norm(got) == _norm(want)
+
+
+def test_nested_loop_multi_comparison_exists(eng):
+    """Two comparison conjuncts must hold on the same inner row — the
+    min/max reduction cannot witness that, so the bounded nested loop
+    serves it (VERDICT r4 missing #2)."""
+    e, fact, dim = eng
+    got = e.sql(
+        "SELECT count(*) AS n FROM fact WHERE EXISTS "
+        "(SELECT 1 FROM dim WHERE dim.dk >= fact.k "
+        "AND dim.dk <= fact.k + 2)")
+    want = int((fact["k"] >= 6).sum())  # dk in 8..15, k in 0..11
+    assert int(got["n"].iloc[0]) == want
+
+
+def test_nested_loop_scalar_order_by_limit(eng):
+    """Correlated scalar subquery with ORDER BY/LIMIT (closest-match
+    lookup) — rejected by the magic-set shape guard, nested loop runs."""
+    e, fact, dim = eng
+    got = e.sql(
+        "SELECT k, (SELECT d.dname FROM dim d WHERE d.dk <= fact.k "
+        "ORDER BY d.dk DESC LIMIT 1) AS nm FROM fact")
+    def oracle(k):
+        c = dim[dim["dk"] <= k]
+        return None if c.empty else \
+            c.sort_values("dk").iloc[-1]["dname"]
+    # row order is the engine's (time-sorted scan); check per-row
+    assert len(got) == len(fact)
+    for r in got.itertuples():
+        assert (None if pd.isna(r.nm) else r.nm) == oracle(r.k), r
+
+
+def test_nested_loop_scalar_outer_ref_in_projection(eng):
+    """Outer reference in the subquery SELECT list: decorrelation only
+    handles WHERE equality refs; the nested loop substitutes anywhere."""
+    e, fact, dim = eng
+    got = e.sql(
+        "SELECT k, (SELECT max(d.dk) - fact.k FROM dim d "
+        "WHERE d.dk > fact.k) AS gap FROM fact")
+    def oracle(k):
+        c = dim[dim["dk"] > k]
+        return None if c.empty else int(c["dk"].max()) - k
+    assert len(got) == len(fact)
+    for r in got.itertuples():
+        assert (None if pd.isna(r.gap) else int(r.gap)) == oracle(r.k), r
+
+
+def test_nested_loop_in_comparison_correlation(eng):
+    """Comparison-correlated IN subquery (allow_cmp is False for IN in
+    the magic-set rewrite) runs on the nested loop."""
+    e, fact, dim = eng
+    got = e.sql(
+        "SELECT count(*) AS n FROM fact WHERE k IN "
+        "(SELECT d.dk - 8 FROM dim d WHERE d.dk < fact.v)")
+    def hit(row):
+        c = dim[dim["dk"] < row.v]
+        return row.k in set(c["dk"] - 8)
+    want = sum(1 for r in fact.itertuples() if hit(r))
+    assert int(got["n"].iloc[0]) == want
+
+
+def test_nested_loop_cap_is_legible(eng):
+    """Past corr_nested_loop_cap the refusal names the knob."""
+    from tpu_olap.executor import EngineConfig
+    e2 = Engine(EngineConfig(corr_nested_loop_cap=3))
+    _, fact, dim = eng
+    e2.register_table("fact", fact, time_column="ts")
+    e2.register_table("dim", dim)
+    with pytest.raises(Exception, match="corr_nested_loop_cap"):
+        e2.sql("SELECT count(*) AS n FROM fact WHERE EXISTS "
+               "(SELECT 1 FROM dim WHERE dim.dk >= fact.k "
+               "AND dim.dk <= fact.k + 2)")

@@ -39,24 +39,58 @@ class TableEntry:
     _frame_lock: object = field(default_factory=threading.Lock,
                                 repr=False, compare=False)
 
-    def iter_chunks(self, batch_rows: int = 1 << 20):
+    def iter_chunks(self, batch_rows: int = 1 << 20, units=None):
         """Stream the parquet source as renamed pandas frames of at most
-        batch_rows rows (parquet-registered tables only)."""
+        batch_rows rows (parquet-registered tables only). `units`
+        restricts the stream to [(path, [row_group, ...]), ...] — the
+        parallel fallback's per-worker assignment — so the read-column
+        subset and column-map rename conventions live here once for the
+        sequential loop, the fork workers, and the schema probe alike."""
         import pyarrow.parquet as pq
         cmap = self.parquet_column_map
         cols = list(self.parquet_read_cols) if self.parquet_read_cols \
             else None
+
+        def _rename(df):
+            return df.rename(columns=cmap) if cmap else df
+
+        if units is not None:
+            for path, rgs in units:
+                pf = pq.ParquetFile(path)
+                try:
+                    for rg in rgs:
+                        df0 = pf.read_row_group(rg, columns=cols) \
+                            .to_pandas()
+                        for s in range(0, len(df0), batch_rows):
+                            yield _rename(df0.iloc[s:s + batch_rows])
+                finally:
+                    pf.close()
+            return
         for path in self.parquet_paths:
             pf = pq.ParquetFile(path)
             try:
                 for batch in pf.iter_batches(batch_size=batch_rows,
                                              columns=cols):
-                    df = batch.to_pandas()
-                    if cmap:
-                        df = df.rename(columns=cmap)
-                    yield df
+                    yield _rename(batch.to_pandas())
             finally:
                 pf.close()
+
+    def parquet_empty_frame(self):
+        """0-row frame with the post-rename parquet schema (the chunked
+        fallback's empty-result prototype), read conventions shared with
+        iter_chunks."""
+        import pyarrow.parquet as pq
+        pf = pq.ParquetFile(self.parquet_paths[0])
+        try:
+            df = pf.schema_arrow.empty_table().to_pandas()
+        finally:
+            pf.close()
+        if self.parquet_read_cols:
+            df = df[[c for c in self.parquet_read_cols
+                     if c in df.columns]]
+        if self.parquet_column_map:
+            df = df.rename(columns=self.parquet_column_map)
+        return df
 
     @property
     def frame(self):

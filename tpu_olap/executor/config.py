@@ -82,6 +82,21 @@ class EngineConfig:
     fallback_chunk_rows: int = 4_000_000
     fallback_chunk_batch_rows: int = 1 << 20
     fallback_scan_row_cap: int = 20_000_000
+    # Correlation shapes the magic-set rewrite cannot serve (multi-
+    # comparison conjuncts, outer refs outside WHERE, ORDER BY/LIMIT
+    # inside the subquery) run a bounded nested loop instead: one
+    # subquery execution per distinct outer key tuple, refused legibly
+    # past this cap (SURVEY.md §2 property 2 "never an error").
+    corr_nested_loop_cap: int = 2048
+    # Chunked-fallback aggregate parallelism (fork pool over parquet row
+    # groups): 0 = auto (min(8, cpu count)), 1 = sequential. The
+    # reference's slow path was distributed Spark; this is its host-side
+    # analog (SURVEY.md §2 L0, §4.4). The timeout bounds how long a
+    # deadlocked fork worker can stall a query before the sequential
+    # loop takes over (fork from a JAX-threaded parent can in principle
+    # inherit a held allocator lock).
+    fallback_parallel_workers: int = 0
+    fallback_parallel_timeout_s: float = 900.0
 
     # execution platform: "device" = default jax backend, "cpu" = numpy path
     platform: str = "device"

@@ -10,6 +10,7 @@ harness can compare the two paths row for row.
 from __future__ import annotations
 
 import re
+import threading
 
 import numpy as np
 import pandas as pd
@@ -17,7 +18,8 @@ import pandas as pd
 from tpu_olap.ir.expr import (BinOp, Col, FuncCall, Lit, Subquery,
                               WindowCall)
 from tpu_olap.planner.exprutil import (contains_agg as _contains_agg,
-                                       expr_key as _k, render as _auto_name,
+                                       expr_key as _k, map_stmt_exprs,
+                                       render as _auto_name,
                                        split_and as _split_and)
 from tpu_olap.planner.sqlparse import (AGG_FUNCS, SelectStmt, UnionStmt)
 from tpu_olap.segments.dictionary import _like_to_regex
@@ -306,23 +308,39 @@ def _resolve_subqueries(stmt: SelectStmt, catalog, config,
             import dataclasses as _dc
             s = e.args[0].stmt
             if not _uncorrelated(s):
-                return _decorrelate_exists(s, outer_tables, catalog,
-                                           config, run)
+                try:
+                    return _decorrelate_exists(s, outer_tables, catalog,
+                                               config, run)
+                except FallbackError as err:
+                    return _nested_loop_corr(
+                        "exists", s, None, stmt, outer_tables, catalog,
+                        config, run, err)
             inner = _dc.replace(s, limit=1, order_by=[])
             sub = run(inner)
             return Lit(len(sub) > 0)
         if isinstance(e, Subquery):
             hit = True
             if not _uncorrelated(e.stmt):
-                return _decorrelate_scalar(e.stmt, outer_tables, catalog,
-                                           config, run)
+                try:
+                    return _decorrelate_scalar(e.stmt, outer_tables,
+                                               catalog, config, run)
+                except FallbackError as err:
+                    return _nested_loop_corr(
+                        "scalar", e.stmt, None, stmt, outer_tables,
+                        catalog, config, run, err)
             return Lit(_scalar_from(run(e.stmt)))
         if isinstance(e, FuncCall) and e.name == "in_subquery":
             hit = True
             lhs = walk(e.args[0])
             if not _uncorrelated(e.args[1].stmt):
-                return _decorrelate_in(lhs, e.args[1].stmt, outer_tables,
-                                       catalog, config, run)
+                try:
+                    return _decorrelate_in(lhs, e.args[1].stmt,
+                                           outer_tables, catalog,
+                                           config, run)
+                except FallbackError as err:
+                    return _nested_loop_corr(
+                        "in", e.args[1].stmt, lhs, stmt, outer_tables,
+                        catalog, config, run, err)
             sub = run(e.args[1].stmt)
             if sub.shape[1] != 1:
                 raise FallbackError(
@@ -356,6 +374,101 @@ def _resolve_subqueries(stmt: SelectStmt, catalog, config,
 
 
 # ---------------------------------------------------------------------------
+def _outer_col_refs(s, outer_tables):
+    """Every outer-scope Col referenced anywhere in the subquery (the
+    nested-loop substitution targets), name-sorted for determinism.
+    Refs inside doubly-nested Subquery nodes are not collected — after
+    substitution those resolve (or fail legibly) at their own scope."""
+    from tpu_olap.ir.expr import map_expr
+    inner_tables = _scope_names(s)
+    found = {}
+
+    def collect(x):
+        if isinstance(x, Col) and "." in x.name:
+            qual = x.name.rsplit(".", 1)[0]
+            if qual not in inner_tables and qual in outer_tables:
+                found.setdefault(x.name, x)
+        return None
+
+    map_stmt_exprs(s, lambda e: e if e is None else map_expr(e, collect))
+    return [found[n] for n in sorted(found)]
+
+
+def _nested_loop_corr(kind, s, lhs, outer_stmt, outer_tables, catalog,
+                      config, run, reason):
+    """Bounded nested-loop decorrelation — the escape hatch for
+    correlation shapes the magic-set rewrite cannot serve (VERDICT r4
+    missing #2; SURVEY.md §2 property 2: rewrite failure must mean slow,
+    never an error). Enumerates the outer scope's distinct correlated-
+    column tuples (probe: DISTINCT over the outer FROM/JOIN tree with
+    WHERE dropped — a superset is correct, the subquery re-applies its
+    own predicates), refuses legibly past corr_nested_loop_cap, runs the
+    subquery once per tuple with outer refs substituted as literals, and
+    folds the results into the same corr_*_map nodes the rewrite emits.
+    `reason` is the rewrite's FallbackError, re-raised when this hatch
+    cannot apply (UNION shapes, no collectable refs)."""
+    import dataclasses as _dc
+    from tpu_olap.ir.expr import map_expr
+    if not isinstance(s, SelectStmt) \
+            or not isinstance(outer_stmt, SelectStmt):
+        raise reason
+    refs = _outer_col_refs(s, outer_tables)
+    if not refs:
+        raise reason
+    cap = config.corr_nested_loop_cap
+    probe = _dc.replace(
+        outer_stmt,
+        projections=[(c, f"__ok{i}") for i, c in enumerate(refs)],
+        distinct=True, where=None, group_by=[], grouping_sets=None,
+        having=None, order_by=[], limit=cap + 1, offset=0)
+    outer_keys = run(probe)
+    if len(outer_keys) > cap:
+        raise FallbackError(
+            f"correlated subquery did not decorrelate ({reason}); the "
+            "nested-loop fallback is bounded at corr_nested_loop_cap="
+            f"{cap} distinct outer key tuples and this outer scope "
+            "has more")
+    names = [c.name for c in refs]
+
+    def substitute(kt):
+        env = dict(zip(names, kt))
+
+        def sub1(x):
+            if isinstance(x, Col) and x.name in env:
+                return Lit(env[x.name])
+            return None
+
+        return map_stmt_exprs(
+            s, lambda e: e if e is None else map_expr(e, sub1))
+
+    kcols = [outer_keys[f"__ok{i}"] for i in range(len(refs))]
+    tuples = set(_key_rows(kcols))
+    if kind == "scalar":
+        items = [(kt, _plain(_scalar_from(run(substitute(kt)))))
+                 for kt in tuples]
+        return FuncCall("corr_scalar_map",
+                        (Lit(tuple(items)), Lit(None)) + tuple(refs))
+    if kind == "exists":
+        keyset = {
+            kt for kt in tuples
+            if len(run(_dc.replace(substitute(kt), limit=1,
+                                   order_by=[])))}
+        return FuncCall("corr_exists_map",
+                        (Lit(tuple(keyset)),) + tuple(refs))
+    pairs = []
+    for kt in tuples:
+        res = run(substitute(kt))
+        if res.shape[1] != 1:
+            raise FallbackError(
+                "IN subquery must project exactly one column")
+        for v in res.iloc[:, 0]:
+            pv = _plain(v)
+            if pv is not None:  # NULL members never match
+                pairs.append(kt + (pv,))
+    return FuncCall("corr_in_map",
+                    (Lit(tuple(pairs)), lhs) + tuple(refs))
+
+
 # Decorrelation (SURVEY.md §3.1 margin the reference served via Spark SQL):
 # an equality-correlated subquery  (... WHERE inner_expr = outer.col ...)
 # becomes a pre-aggregated key->value map over the inner table, evaluated
@@ -770,6 +883,91 @@ def _join_and_filter(stmt, df, catalog, time_col, config,
     return df
 
 
+def _gset_expr(e, gkeys, full_keys):
+    """Projection expr for one grouping set: absent group keys become
+    NULL literals, GROUPING(key) becomes 0/1. Shared by the fallback
+    union below and the device-union leg builder (grouping_set_legs)."""
+    if isinstance(e, FuncCall) and e.name == "grouping" \
+            and len(e.args) == 1:
+        return Lit(0 if _k(e.args[0]) in gkeys else 1)
+    if _k(e) in full_keys and _k(e) not in gkeys:
+        return Lit(None)
+    if isinstance(e, BinOp):
+        return BinOp(e.op, _gset_expr(e.left, gkeys, full_keys),
+                     _gset_expr(e.right, gkeys, full_keys))
+    if isinstance(e, FuncCall) and e.name not in AGG_FUNCS:
+        return FuncCall(e.name, tuple(_gset_expr(a, gkeys, full_keys)
+                                      for a in e.args))
+    return e
+
+
+def grouping_set_legs(stmt):
+    """Decompose a GROUPING SETS/ROLLUP/CUBE statement into one ordinary
+    GROUP BY statement per set, for the DEVICE union path (VERDICT r4
+    missing #4: every leg is an already-device-eligible GROUP BY, so a
+    union of cached-template dispatches serves the construct at device
+    speed). Returns (out_names, legs); each leg is (leg_stmt, consts)
+    where consts maps output columns this set does not compute (absent
+    group keys -> None, GROUPING(k) -> 0/1) for post-hoc reattachment —
+    keeping constant projections OUT of the leg SQL keeps every leg on
+    the same compiled template family as its plain-GROUP BY twin.
+    Output aliases are pinned from the ORIGINAL exprs so every leg
+    yields the same column names. ORDER BY/LIMIT are stripped (the
+    caller applies them over the union). HAVING is left untouched: a
+    leg whose HAVING references columns outside its set simply fails
+    rewrite and runs on the fallback, which evaluates it exactly as the
+    whole-statement fallback would (_aggregate receives the same
+    group_exprs + untransformed HAVING either way)."""
+    import dataclasses as _dc
+    if any(isinstance(e, Col) and e.name == "*"
+           for e, _ in stmt.projections):
+        raise FallbackError("SELECT * with GROUPING SETS is fallback-only")
+    full_keys = {_k(g) for g in stmt.group_by}
+    out_names = [a or _auto_name(e) for e, a in stmt.projections]
+    legs = []
+    for gset in stmt.grouping_sets:
+        gkeys = {_k(g) for g in gset}
+        projs, consts = [], {}
+        for (e, _a), name in zip(stmt.projections, out_names):
+            t = _gset_expr(e, gkeys, full_keys)
+            if isinstance(t, Lit) and not isinstance(e, Lit):
+                consts[name] = t.value
+                continue
+            projs.append((t, name))
+        if not projs:
+            # all projections folded to constants (pure-dimension set):
+            # the leg must still yield one row PER GROUP of this set
+            # (one row for the () set), so probe with a count the caller
+            # reindexes away — without it the degenerate SELECT returns
+            # zero rows and the set's rows vanish from the union
+            projs.append((FuncCall("count", ()), "__gsrows"))
+        legs.append((_dc.replace(
+            stmt, projections=list(projs), group_by=list(gset),
+            grouping_sets=None, order_by=[], limit=None, offset=0),
+            consts))
+    return out_names, legs
+
+
+def union_order_keys(stmt, out_names):
+    """ORDER BY key names over a grouping-set union: each item must
+    reference an output column — by its spelled name or structurally
+    (the parser resolves output aliases to their exprs, so ORDER BY s
+    arrives as the sum(v) tree and must map back to 's'). None when an
+    item references anything else (per-row exprs are meaningless over a
+    union of differently-grouped rows)."""
+    key_of = {_k(e): n
+              for (e, _a), n in zip(stmt.projections, out_names)}
+    keys = []
+    for item in stmt.order_by:
+        name = _auto_name(item.expr)
+        if name not in out_names:
+            name = key_of.get(_k(item.expr))
+        if name is None:
+            return None
+        keys.append(name)
+    return keys
+
+
 def _grouping_sets_aggregate(df, exprs, out_names, stmt, time_col):
     """GROUP BY ROLLUP/CUBE/GROUPING SETS (the reference served these
     via full Spark SQL, SURVEY.md §3.1): one _aggregate pass per
@@ -780,39 +978,20 @@ def _grouping_sets_aggregate(df, exprs, out_names, stmt, time_col):
     full_keys = {_k(g) for g in stmt.group_by}
     inner = _dc.replace(stmt, order_by=[], limit=None, offset=0)
 
-    def per_set(e, gkeys):
-        """Projection expr for one grouping set: absent group keys
-        become NULL literals, GROUPING(key) becomes 0/1."""
-        if isinstance(e, FuncCall) and e.name == "grouping" \
-                and len(e.args) == 1:
-            return Lit(0 if _k(e.args[0]) in gkeys else 1)
-        if _k(e) in full_keys and _k(e) not in gkeys:
-            return Lit(None)
-        if isinstance(e, BinOp):
-            return BinOp(e.op, per_set(e.left, gkeys),
-                         per_set(e.right, gkeys))
-        if isinstance(e, FuncCall) and e.name not in AGG_FUNCS:
-            return FuncCall(e.name, tuple(per_set(a, gkeys)
-                                          for a in e.args))
-        return e
-
     parts = []
     for gset in stmt.grouping_sets:
         gkeys = {_k(g) for g in gset}
-        sub_exprs = [per_set(e, gkeys) for e in exprs]
+        sub_exprs = [_gset_expr(e, gkeys, full_keys) for e in exprs]
         parts.append(_aggregate(df, sub_exprs, out_names, list(gset),
                                 inner, time_col))
     out = pd.concat(parts, ignore_index=True) if parts \
         else pd.DataFrame(columns=out_names)
     if stmt.order_by:
-        keys = []
-        for item in stmt.order_by:
-            name = _auto_name(item.expr)
-            if name not in out.columns:
-                raise FallbackError(
-                    "ORDER BY over GROUPING SETS must reference output "
-                    f"columns ({name!r} is not one)")
-            keys.append(name)
+        keys = union_order_keys(stmt, out_names)
+        if keys is None:
+            raise FallbackError(
+                "ORDER BY over GROUPING SETS must reference output "
+                "columns")
         out = _sort_order_items(out, keys, stmt.order_by)
     return out.reset_index(drop=True)
 
@@ -1074,7 +1253,7 @@ def _execute_chunked(stmt: SelectStmt, entry, catalog, config):
         return _chunked_aggregate(stmt, chunks, exprs, out_names,
                                   group_exprs, catalog, time_col, config,
                                   pair_cap=config.fallback_scan_row_cap,
-                                  derived_cache=dcache)
+                                  derived_cache=dcache, entry=entry)
     return _chunked_scan(stmt, chunks, exprs, out_names, catalog,
                          time_col, config, derived_cache=dcache)
 
@@ -1132,9 +1311,186 @@ def _chunked_scan(stmt, chunks, exprs, out_names, catalog, time_col,
     return out[out_names].iloc[lo:hi].reset_index(drop=True)
 
 
+# Fork-inherited context for the parallel chunked fallback: the worker
+# function must be module-level (Pool pickles it by reference), but the
+# closures/frames it needs are NOT picklable — they are handed over via
+# this global, which the fork()ed children inherit by memory snapshot.
+# The lock serializes concurrent parallel fallbacks (the BI server is a
+# ThreadingHTTPServer and the fallback path takes no device lock): the
+# global must not be overwritten between set and fork, or query A's
+# workers would compute with query B's closures.
+_PFORK_CTX = None
+_PFORK_LOCK = threading.Lock()
+
+
+def _pair_cap_refuse(name: str, pair_cap: int):
+    """A high-cardinality DISTINCT aggregate needs the full value set;
+    refusing with a clear error beats an OOM (the "never an error"
+    property is already forfeit either way — this makes the failure
+    legible/bounded). Shared by the sequential compact() and the fork
+    workers so both paths refuse identically."""
+    remedy = (
+        "use approx_count_distinct on the device path or raise the cap"
+        if name in ("count_distinct", "approx_count_distinct",
+                    "theta_sketch") else "raise the cap")
+    raise FallbackError(
+        f"chunked fallback {name} exceeds "
+        f"fallback_scan_row_cap={pair_cap} distinct pairs; {remedy}")
+
+
+def _compact_pairs(pairs, distinct_specs, pair_cap):
+    """Dedup each key's accumulated pair frames down to one and enforce
+    the pair cap. Returns total retained pair rows."""
+    total = 0
+    for k, fs in pairs.items():
+        if len(fs) > 1:
+            pairs[k] = [pd.concat(fs, ignore_index=True)
+                        .drop_duplicates()]
+        if pairs[k] and len(pairs[k][0]) > pair_cap:
+            _pair_cap_refuse(distinct_specs[k], pair_cap)
+        total += len(pairs[k][0]) if pairs[k] else 0
+    return total
+
+
+def _pfork_worker(units):
+    """One worker: stream assigned (path, row-group) units via the
+    entry's iter_chunks (single source of the parquet read conventions),
+    join+filter each chunk, compute partial aggregates, locally compact,
+    and return (partial frames, {agg key: distinct-pair frames}).
+    Distinct pairs are compacted and cap-checked incrementally (same
+    ~1M-NEW-row trigger as the sequential loop) so a high-cardinality
+    DISTINCT refuses legibly from inside the worker instead of
+    accumulating toward an OOM."""
+    (entry, chunk_partial, join, batch, gcols,
+     merge_ops, distinct_specs, pair_cap) = _PFORK_CTX
+    partials, pairs = [], {}
+    pending_pairs = 0
+    for chunk in entry.iter_chunks(batch_rows=batch, units=units):
+        df = join(chunk)
+        if not len(df):
+            continue
+        part, dp = chunk_partial(df)
+        partials.append(part)
+        for k, p in dp.items():
+            pairs.setdefault(k, []).append(p)
+            pending_pairs += len(p)
+        if pending_pairs > (1 << 20):
+            _compact_pairs(pairs, distinct_specs, pair_cap)
+            pending_pairs = 0  # counts NEW pairs since last compaction
+    if len(partials) > 1:  # bound the IPC payload
+        cat = pd.concat(partials, ignore_index=True)
+        if gcols:
+            partials = [cat.groupby(gcols, sort=False, dropna=False)
+                           .agg(merge_ops).reset_index()]
+        else:
+            partials = [cat.agg(merge_ops).to_frame().T]
+    _compact_pairs(pairs, distinct_specs, pair_cap)
+    return partials, pairs
+
+
+def _parallel_chunk_partials(stmt, entry, catalog, config, time_col,
+                             chunk_partial, gcols, merge_ops,
+                             distinct_specs, pair_cap, dcache):
+    """Fan the chunk loop over a fork Pool of row-group readers (VERDICT
+    r4 missing #3: the reference's slow path was distributed Spark; a
+    single-core pandas loop at SF100 is minutes per query, and the chunk
+    loop is embarrassingly parallel for decomposable partials). Returns
+    (partials, pair_parts, empty_proto) or None when the parallel path
+    does not apply (sequential caller takes over): no parquet paths,
+    fewer than two row groups, one worker, or no fork on this platform.
+    The derived-join cache is pre-populated by the 0-row schema probe
+    BEFORE forking, so every worker inherits the executed derived frames
+    instead of re-running them per process."""
+    import multiprocessing as mp
+    import os as _os
+
+    global _PFORK_CTX
+    paths = entry.parquet_paths if entry is not None else None
+    if not paths:
+        return None
+    workers = config.fallback_parallel_workers
+    if workers == 0:
+        workers = min(8, _os.cpu_count() or 1)
+    try:
+        ctx = mp.get_context("fork")
+    except ValueError:
+        return None
+    import pyarrow.parquet as pq
+    units = []  # (path, row-group index)
+    for path in paths:
+        pf = pq.ParquetFile(path)
+        try:
+            units.extend((path, rg)
+                         for rg in range(pf.metadata.num_row_groups))
+        finally:
+            pf.close()
+    workers = min(workers, len(units))
+    if workers < 2:
+        return None
+
+    # 0-row schema probe: the real joined schema for the empty-result
+    # path, and it executes any derived-table joins once into dcache
+    empty_proto = _join_and_filter(stmt, entry.parquet_empty_frame(),
+                                   catalog, time_col, config,
+                                   derived_cache=dcache)
+
+    def join(chunk):
+        return _join_and_filter(stmt, chunk, catalog, time_col, config,
+                                derived_cache=dcache)
+
+    # interleave row groups across workers (adjacent groups tend to have
+    # correlated sizes); group back into per-worker (path, [rgs]) lists
+    per_worker = []
+    for w in range(workers):
+        mine = units[w::workers]
+        by_path: dict = {}
+        for path, rg in mine:
+            by_path.setdefault(path, []).append(rg)
+        per_worker.append(sorted(by_path.items()))
+
+    # the lock covers only ctx-set -> fork: Pool() forks its workers at
+    # construction, each child snapshotting _PFORK_CTX by fork memory
+    # copy, so the global can be cleared (and the lock released) before
+    # the map runs — concurrent queries' parallel fallbacks overlap
+    # instead of serializing behind the slowest pool
+    with _PFORK_LOCK:
+        _PFORK_CTX = (entry, chunk_partial, join,
+                      config.fallback_chunk_batch_rows,
+                      gcols, merge_ops, distinct_specs, pair_cap)
+        try:
+            pool = ctx.Pool(workers)
+        except Exception:  # noqa: BLE001 — sequential retry is sound
+            return None
+        finally:
+            _PFORK_CTX = None
+    try:
+        # the parent process has live JAX/XLA threads, so fork carries a
+        # lock-inheritance hazard (workers never call jax, and pyarrow
+        # re-inits its pools atfork, but belt-and-braces): any worker
+        # failure OR a stuck pool degrades to the sequential loop — the
+        # chunk generator is still unconsumed at this point, and the
+        # bounded timeout keeps a deadlocked child from stalling the
+        # query for more than fallback_parallel_timeout_s
+        with pool:
+            results = pool.map_async(_pfork_worker, per_worker) \
+                .get(timeout=config.fallback_parallel_timeout_s)
+    except FallbackError:
+        raise  # a worker's legible refusal (pair cap), not a crash
+    except Exception:  # noqa: BLE001 — sequential retry is sound
+        return None
+    partials = []
+    pair_parts = {k: [] for k in distinct_specs}
+    for parts, pairs in results:
+        partials.extend(parts)
+        for k, fs in pairs.items():
+            pair_parts[k].extend(fs)
+    return partials, pair_parts, empty_proto
+
+
 def _chunked_aggregate(stmt, chunks, exprs, out_names, group_exprs,
                        catalog, time_col, config,
-                       pair_cap=20_000_000, derived_cache=None):
+                       pair_cap=20_000_000, derived_cache=None,
+                       entry=None):
     # every aggregate call reachable from projections / HAVING / ORDER BY
     agg_calls: dict = {}
     for e in exprs:
@@ -1162,6 +1518,30 @@ def _chunked_aggregate(stmt, chunks, exprs, out_names, group_exprs,
                           "count_distinct", "approx_count_distinct",
                           "theta_sketch", "sum_distinct", "avg_distinct")}
     distinct_keys = list(distinct_specs)
+
+    # merge_ops is complete BEFORE any chunk runs (mirrors the per-spec
+    # branches of chunk_partial): the parallel path's parent process
+    # merges worker partials without ever executing a chunk itself, and
+    # an unsupported aggregate errors before any IO is spent
+    for i, (k, e0) in enumerate(specs):
+        e, cond = _unwrap(e0)
+        if k in distinct_specs:
+            continue
+        if e.name == "count" and not e.args:
+            if cond is not None:
+                merge_ops[f"p{i}"] = "sum"
+            continue
+        if e.name == "count":
+            merge_ops[f"p{i}"] = "sum"
+        elif e.name in ("sum", "avg"):
+            merge_ops[f"p{i}"] = "sum"
+            if e.name == "avg" and cond is not None:
+                merge_ops[f"p{i}n"] = "sum"
+        elif e.name in ("min", "max"):
+            merge_ops[f"p{i}"] = e.name
+        else:
+            raise FallbackError(
+                f"aggregate {e.name!r} has no chunked fallback")
 
     def chunk_partial(df):
         """One chunk -> (partials frame, {agg key: distinct-pairs frame})."""
@@ -1191,10 +1571,11 @@ def _chunked_aggregate(stmt, chunks, exprs, out_names, group_exprs,
                     subset=[f"v{j}" for j in range(len(e.args))])
                 dpairs[k] = p.drop_duplicates()
                 continue
+            # merge_ops is pre-computed above (single source of truth);
+            # this function only materializes the matching work columns
             if e.name == "count" and not e.args:
                 if mask is not None:  # filtered row count
                     work[f"p{i}"] = mask.astype(np.int64)
-                    merge_ops[f"p{i}"] = "sum"
                 continue  # unfiltered: __rows covers it
             v = _eval_agg_input(e.args[0], df, time_col)
             if mask is not None:
@@ -1202,17 +1583,13 @@ def _chunked_aggregate(stmt, chunks, exprs, out_names, group_exprs,
             if e.name == "count":
                 # v.where(mask) above already nulled masked-out rows
                 work[f"p{i}"] = v.notna().astype(np.int64)
-                merge_ops[f"p{i}"] = "sum"
             elif e.name in ("sum", "avg"):
                 work[f"p{i}"] = v
-                merge_ops[f"p{i}"] = "sum"
                 if e.name == "avg" and mask is not None:
                     # filtered avg denominator: filtered row count
                     work[f"p{i}n"] = mask.astype(np.int64)
-                    merge_ops[f"p{i}n"] = "sum"
             elif e.name in ("min", "max"):
                 work[f"p{i}"] = v
-                merge_ops[f"p{i}"] = e.name
             else:
                 raise FallbackError(
                     f"aggregate {e.name!r} has no chunked fallback")
@@ -1234,49 +1611,39 @@ def _chunked_aggregate(stmt, chunks, exprs, out_names, group_exprs,
                                .agg(merge_ops).reset_index()]
             else:
                 partials = [cat.agg(merge_ops).to_frame().T]
-        for k in distinct_keys:
-            if len(pair_parts[k]) > 1:
-                pair_parts[k] = [pd.concat(pair_parts[k],
-                                           ignore_index=True)
-                                 .drop_duplicates()]
-            if pair_parts[k] and len(pair_parts[k][0]) > pair_cap:
-                # a high-cardinality DISTINCT aggregate needs the full
-                # value set; refusing with a clear error beats an OOM
-                # (the "never an error" property is already forfeit
-                # either way — this makes the failure legible/bounded)
-                name = distinct_specs[k]
-                remedy = (
-                    "use approx_count_distinct on the device path or "
-                    "raise the cap"
-                    if name in ("count_distinct", "approx_count_distinct",
-                                "theta_sketch") else "raise the cap")
-                raise FallbackError(
-                    f"chunked fallback {name} exceeds "
-                    f"fallback_scan_row_cap={pair_cap} distinct pairs; "
-                    f"{remedy}")
+        _compact_pairs(pair_parts, distinct_specs, pair_cap)
 
     pending_rows = 0
     empty_proto = None   # 0-row joined frame with the real schema
     dcache = derived_cache if derived_cache is not None else {}
-    for chunk in chunks:
-        df = _join_and_filter(stmt, chunk, catalog, time_col, config,
-                              derived_cache=dcache)
-        if empty_proto is None:
-            empty_proto = df.iloc[0:0]
-        if not len(df):
-            continue
-        part, dpairs = chunk_partial(df)
-        partials.append(part)
-        for k, p in dpairs.items():
-            pair_parts[k].append(p)
-        # distinct pairs count toward the compaction trigger too — a
-        # high-cardinality DISTINCT grows pairs by up to a whole chunk
-        # while adding one partial row, and the pair cap is enforced
-        # inside compact()
-        pending_rows += len(part) + sum(len(p) for p in dpairs.values())
-        if pending_rows > (1 << 20):
-            compact()
-            pending_rows = 0
+    par = _parallel_chunk_partials(stmt, entry, catalog, config, time_col,
+                                   chunk_partial, gcols, merge_ops,
+                                   distinct_specs, pair_cap, dcache)
+    if par is not None:
+        partials, pp, empty_proto = par
+        for k, frames in pp.items():
+            pair_parts[k].extend(frames)
+        compact()
+    else:
+        for chunk in chunks:
+            df = _join_and_filter(stmt, chunk, catalog, time_col, config,
+                                  derived_cache=dcache)
+            if empty_proto is None:
+                empty_proto = df.iloc[0:0]
+            if not len(df):
+                continue
+            part, dpairs = chunk_partial(df)
+            partials.append(part)
+            for k, p in dpairs.items():
+                pair_parts[k].append(p)
+            # distinct pairs count toward the compaction trigger too — a
+            # high-cardinality DISTINCT grows pairs by up to a whole
+            # chunk while adding one partial row, and the pair cap is
+            # enforced inside compact()
+            pending_rows += len(part) + sum(len(p) for p in dpairs.values())
+            if pending_rows > (1 << 20):
+                compact()
+                pending_rows = 0
     if not partials:
         if gcols:
             return pd.DataFrame(columns=out_names)
