@@ -177,7 +177,15 @@ EXTRA_LEGS = [
          ["tools/fit_pallas_budget.py"],
          {"FIT_AUTO_JSON": "BENCH_TPU_AUTO_r05.json",
           "FIT_NEVER_JSON": "BENCH_TPU_PALLAS_never_r05.json"},
-         timeout=900)),
+         timeout=900)
+     if all(os.path.exists(os.path.join(REPO, f)) for f in
+            ("BENCH_TPU_AUTO_r05.json", "BENCH_TPU_PALLAS_never_r05.json"))
+     else ("skipped", None)),  # inputs pending: not a leg failure
+    ("tpu cost calibration r05",
+     _fresh_done(os.path.join("tpu_olap", "planner",
+                              "cost_calibration.json")),
+     lambda: attempt_cmd(["tools/calibrate_cost.py"],
+                         {"CAL_REQUIRE_TPU": "1"}, timeout=900)),
     ("sf10 bench r05", _file_done("BENCH_TPU_SF10_r05.json"),
      _bench_leg("BENCH_TPU_SF10_r05.json", rows=60_000_000)),
     ("sf20 bench r05", _file_done("BENCH_TPU_SF20_r05.json"),
@@ -231,7 +239,7 @@ def main():
                         if isinstance(r2, dict) and "value" in r2 else {}),
                      **({"error": r2} if s2 in ("error", "timeout")
                         and r2 else {})})
-                if s2 == "ok":
+                if s2 in ("ok", "skipped"):
                     continue
                 if s2 in ("timeout", "refused-cpu") and not tunnel_alive():
                     break  # tunnel closed mid-run; retry next cycle
