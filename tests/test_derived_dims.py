@@ -98,10 +98,13 @@ def test_calendar_bucket_stream_cached_with_parity():
     first = store[tok]
     eng.sql(q)
     assert store[tok] is first
-    # an hourly (uniform) granularity adds nothing
+    # an hourly (uniform) granularity caches its own id stream too
+    # (round 5: uniform buckets ride a resident stream so timeseries
+    # dispatches read [S,R] int32 ids instead of the int64 __time)
     eng.sql("SELECT date_trunc('hour', ts) AS h, count(*) AS n FROM t "
             "GROUP BY date_trunc('hour', ts) LIMIT 5")
-    assert len(store) == 1
+    assert len(store) == 2
+    assert any(t.startswith("u:") for t in store)
 
 
 def test_pallas_auto_flop_budget_gates_large_k():

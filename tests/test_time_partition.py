@@ -1,0 +1,194 @@
+"""Time-partitioned ingest (the Druid segmentGranularity analog,
+SURVEY.md §3.4 segment store / §3.5 P4 interval pruning) and the
+residual interval-mask elision it unlocks (round 5, VERDICT r4 weak #1:
+__time int64 is typically the widest column a filtered aggregate reads;
+when every scanned segment sits inside one query interval the row-level
+mask is constant-true and the kernel should neither evaluate it nor
+read __time)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpu_olap import Engine
+from tpu_olap.executor.lowering import lower
+from tpu_olap.segments.ingest import (ingest_pandas,
+                                      resolve_time_partition)
+
+
+def _table(n=120_000, years=4, seed=5):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "ts": pd.to_datetime("1993-01-01")
+        + pd.to_timedelta(rng.integers(0, 86400 * 365 * years, n),
+                          unit="s"),
+        "g": rng.choice(["a", "b", "c", "d"], n),
+        "v": rng.integers(0, 1000, n).astype(np.int64),
+    })
+
+
+def test_resolve_auto_granularity():
+    day, month, year = 86_400_000, 2_629_800_000, 31_557_600_000
+    # plenty of blocks per day -> day
+    assert resolve_time_partition("auto", 0, 10 * day, 10_000_000,
+                                  4096) == "day"
+    # ~244 blocks over 4 years -> month amortizes (48 <= 61), day not
+    assert resolve_time_partition("auto", 0, 4 * year, 1_000_000,
+                                  4096) == "month"
+    # ~30 blocks over 4 years -> year
+    assert resolve_time_partition("auto", 0, 4 * year, 120_000,
+                                  4096) == "year"
+    # too small to amortize even years -> no partitioning
+    assert resolve_time_partition("auto", 0, 4 * year, 4_000,
+                                  4096) is None
+    # explicit values pass through; degenerate span -> None
+    assert resolve_time_partition("month", 0, 1, 10, 4) == "month"
+    assert resolve_time_partition("auto", 5, 5, 10, 4) is None
+
+
+def test_partition_ranges_disjoint_and_exact():
+    segs = ingest_pandas("t", _table(), time_column="ts",
+                        block_rows=4096, time_partition="year")
+    bounds = sorted((s.meta.time_min, s.meta.time_max)
+                    for s in segs.segments)
+    years = {pd.Timestamp(b[0], unit="ms").year for b in bounds}
+    assert years == {1993, 1994, 1995, 1996}
+    for lo, hi in bounds:
+        assert pd.Timestamp(lo, unit="ms").year \
+            == pd.Timestamp(hi, unit="ms").year
+    # every row present exactly once
+    assert sum(s.meta.n_valid for s in segs.segments) == 120_000
+
+
+def test_partitioned_streaming_matches_memory():
+    """Parquet streaming (chunk-at-a-time arrival) must produce the same
+    query results as in-memory ingest, with partition-pruned scans."""
+    import tempfile
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    df = _table(n=80_000)
+    d = tempfile.mkdtemp()
+    paths = []
+    for i in range(2):  # unsorted multi-file arrival
+        p = f"{d}/f{i}.parquet"
+        pq.write_table(pa.Table.from_pandas(
+            df.iloc[i * 40_000:(i + 1) * 40_000], preserve_index=False),
+            p, row_group_size=8192)
+        paths.append(p)
+    mem = Engine()
+    mem.register_table("t", df, time_column="ts", block_rows=2048)
+    par = Engine()
+    par.register_table("t", paths, time_column="ts", block_rows=2048)
+    sql = ("SELECT g, sum(v) AS s, count(*) AS n FROM t "
+           "WHERE ts >= '1994-01-01' AND ts < '1996-01-01' "
+           "GROUP BY g ORDER BY g")
+    a, b = mem.sql(sql), par.sql(sql)
+    pd.testing.assert_frame_equal(a, b, check_dtype=False)
+    m = par.runner.history[-1]
+    assert m["segments_scanned"] < m["segments_total"], m
+
+
+def test_covered_interval_elides_time_reads():
+    eng = Engine()
+    eng.register_table("t", _table(), time_column="ts",
+                       block_rows=4096, time_partition="year")
+    tab = eng.planner.plan("SELECT sum(v) AS s FROM t").entry.segments
+
+    aligned = eng.planner.plan(
+        "SELECT g, sum(v) AS s FROM t "
+        "WHERE ts >= '1994-01-01' AND ts < '1995-01-01' GROUP BY g")
+    ph = lower(aligned.query, tab, eng.config)
+    assert "__time" not in ph.columns  # mask elided, no time read
+
+    unaligned = eng.planner.plan(
+        "SELECT g, sum(v) AS s FROM t "
+        "WHERE ts >= '1994-03-15' AND ts < '1995-07-02' GROUP BY g")
+    ph2 = lower(unaligned.query, tab, eng.config)
+    assert "__time" in ph2.columns  # boundary segments keep the mask
+
+    # parity on the boundary-straddling interval (the mask must be
+    # exact where it IS evaluated)
+    df = _table()
+    sql = ("SELECT g, sum(v) AS s, count(*) AS n FROM t "
+           "WHERE ts >= '1994-03-15' AND ts < '1995-07-02' "
+           "GROUP BY g ORDER BY g")
+    got = eng.sql(sql)
+    sub = df[(df.ts >= "1994-03-15") & (df.ts < "1995-07-02")]
+    want = sub.groupby("g")["v"].agg(["sum", "size"]).reset_index()
+    assert list(got["s"]) == list(want["sum"])
+    assert list(got["n"]) == list(want["size"])
+
+
+def test_cached_bucket_stream_elides_time_reads():
+    """Calendar/uniform bucketing rides a resident derived id stream, so
+    a timeseries without raw-timestamp consumers reads no __time."""
+    eng = Engine()
+    eng.register_table("t", _table(), time_column="ts", block_rows=4096)
+    tab = eng.planner.plan("SELECT sum(v) AS s FROM t").entry.segments
+    plan = eng.planner.plan(
+        "SELECT month(ts) AS m, sum(v) AS q FROM t "
+        "GROUP BY month(ts) ORDER BY m")
+    ph = lower(plan.query, tab, eng.config)
+    assert "__time" not in ph.columns
+    got = eng.sql("SELECT month(ts) AS m, sum(v) AS q FROM t "
+                  "GROUP BY month(ts) ORDER BY m")
+    df = _table()
+    want = df.assign(m=df.ts.dt.month).groupby("m")["v"].sum()
+    assert list(got["q"]) == list(want)
+
+
+@pytest.mark.parametrize("shards", [None, 8])
+def test_partitioned_sharded_parity(shards):
+    """Partition-aligned segments under the 8-device mesh: pruned
+    dispatch + psum merge stays parity-exact."""
+    from tpu_olap.executor import EngineConfig
+    df = _table(n=60_000)
+    eng = Engine(EngineConfig(num_shards=shards))
+    eng.register_table("t", df, time_column="ts", block_rows=1024,
+                       time_partition="month")
+    sql = ("SELECT g, sum(v) AS s FROM t "
+           "WHERE ts >= '1993-06-01' AND ts < '1994-06-01' "
+           "GROUP BY g ORDER BY g")
+    got = eng.sql(sql)
+    assert eng.last_plan.rewritten
+    sub = df[(df.ts >= "1993-06-01") & (df.ts < "1994-06-01")]
+    want = sub.groupby("g")["v"].sum().reset_index()
+    assert list(got["s"]) == list(want["v"])
+
+
+def test_numeric_bounds_prune_denormalized_dims():
+    """SURVEY.md §3.5 P4 numeric-bounds leg: a selector/bound filter on
+    a denormalized LONG dim (the SSB d_year pattern) prunes segments by
+    the manifest's per-column min/max — with time-partitioned ingest the
+    column correlates with the partition axis, so whole partitions drop
+    before dispatch and the window slice covers the survivors."""
+    rng = np.random.default_rng(8)
+    n = 200_000
+    ts = pd.to_datetime("1993-01-01") \
+        + pd.to_timedelta(rng.integers(0, 86400 * 365 * 4, n), unit="s")
+    df = pd.DataFrame({
+        "ts": ts,
+        "dyear": ts.year.astype(np.int64),
+        "g": rng.choice(["a", "b"], n),
+        "v": rng.integers(0, 100, n).astype(np.int64),
+    })
+    eng = Engine()
+    eng.register_table("t", df, time_column="ts", block_rows=4096,
+                       time_partition="year")
+    sql = ("SELECT g, sum(v) AS s, count(*) AS n FROM t "
+           "WHERE dyear = 1994 GROUP BY g ORDER BY g")
+    got = eng.sql(sql)
+    m = eng.runner.history[-1]
+    assert m["segments_scanned"] < m["segments_total"] / 2, m
+    sub = df[df.dyear == 1994]
+    want = sub.groupby("g")["v"].agg(["sum", "size"]).reset_index()
+    assert list(got["s"]) == list(want["sum"])
+    assert list(got["n"]) == list(want["size"])
+    # range predicate prunes too (inclusive envelope)
+    got2 = eng.sql("SELECT count(*) AS n FROM t "
+                   "WHERE dyear >= 1995 AND dyear <= 1996")
+    m2 = eng.runner.history[-1]
+    assert m2["segments_scanned"] < m2["segments_total"]
+    assert int(got2["n"].iloc[0]) == int((df.dyear >= 1995).sum()
+                                         - (df.dyear > 1996).sum())

@@ -163,6 +163,85 @@ def _interval_mask_fn(intervals, t0, t1, pool):
     return fn
 
 
+def _filter_numeric_bounds(spec, table, vexprs=None) -> dict:
+    """Per-column [lo, hi] requirements implied by top-level AND
+    conjuncts of the filter, for manifest pruning (SURVEY.md §3.5 P4's
+    numeric-bounds leg — the denormalized-dim analog of interval
+    pruning: with time-partitioned ingest a selector like d_year = 1993
+    sees tight per-segment min/max and drops whole partitions before
+    dispatch). Conservative: plain LONG columns only, no extraction fns,
+    numeric-ordered bounds; OR/NOT shapes contribute nothing; strict
+    bounds prune with their inclusive envelope (a superset scan is
+    always correct — the kernel's filter stays exact)."""
+    from tpu_olap.ir.filters import (AndFilter, BoundFilter, InFilter,
+                                     SelectorFilter)
+
+    def _num(v):
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            try:
+                return float(v)
+            except (TypeError, ValueError):
+                return None
+
+    out: dict = {}
+
+    def add(col, lo, hi):
+        # a virtual column shadows any same-named physical column in
+        # filter evaluation — its values are an expression, so the
+        # physical manifest's min/max say nothing about it
+        if vexprs and col in vexprs:
+            return
+        if table.schema.get(col) is not ColumnType.LONG:
+            return
+        plo, phi = out.get(col, (None, None))
+        if lo is not None:
+            plo = lo if plo is None else max(plo, lo)
+        if hi is not None:
+            phi = hi if phi is None else min(phi, hi)
+        out[col] = (plo, phi)
+
+    def walk(f):
+        if isinstance(f, AndFilter):
+            for g in f.fields:
+                walk(g)
+        elif isinstance(f, SelectorFilter) and f.extraction_fn is None:
+            v = _num(f.value)
+            if v is not None:
+                add(f.dimension, v, v)
+        elif isinstance(f, InFilter) \
+                and getattr(f, "extraction_fn", None) is None:
+            vs = [_num(v) for v in f.values]
+            if vs and all(v is not None for v in vs):
+                add(f.dimension, min(vs), max(vs))
+        elif isinstance(f, BoundFilter) and f.extraction_fn is None \
+                and f.ordering == "numeric":
+            add(f.dimension, _num(f.lower), _num(f.upper))
+
+    if spec is not None:
+        walk(spec)
+    return out
+
+
+def _elide_covered_imask(imask_fn, pruned_segs, intervals):
+    """Residual interval-mask elision (SURVEY.md §3.5 P4 extended to row
+    level): ingest globally time-sorts rows, so a scanned segment's
+    [time_min, time_max] usually sits entirely inside one query interval
+    — the row-level mask is then constant-true over every scanned block,
+    and the kernel neither evaluates it nor reads __time for it (8
+    bytes/row of HBM scan traffic on a v5e, typically the single widest
+    column a filtered aggregate touches). Segments straddling an
+    interval edge keep the device mask. Compile-time decision: pruning
+    is static per plan, so the elision caches with the template."""
+    if imask_fn is None or not pruned_segs:
+        return imask_fn
+    if all(any(iv.start <= s.meta.time_min and iv.end > s.meta.time_max
+               for iv in intervals) for s in pruned_segs):
+        return None
+    return imask_fn
+
+
 def _collect_columns(table, query, dim_plans, agg_plans, vexprs,
                      need_time: bool):
     cols: set[str] = set()
@@ -307,7 +386,8 @@ def _lower_agg(query, table, config) -> PhysicalPlan:
     intervals, t_min, t_max, empty = _time_range(query, table)
     vexprs = {v.name: v.expression for v in query.virtual_columns}
 
-    bucket_plan = compile_granularity(query.granularity, t_min, t_max, pool)
+    bucket_plan = compile_granularity(query.granularity, t_min, t_max,
+                                      pool, table.time_boundary)
 
     if isinstance(query, GroupByQuerySpec):
         dim_specs = query.dimensions
@@ -387,11 +467,22 @@ def _lower_agg(query, table, config) -> PhysicalPlan:
                     f"sketch index space {total}×{radix} overflows int32 "
                     "without x64")
 
-    need_time = (bucket_plan.kind != "all" or imask_fn is not None
-                 or any(dp.kind == "timeformat" for dp in dim_plans))
+    pruned_segs = table.prune(
+        intervals, _filter_numeric_bounds(query.filter, table, vexprs))
+    imask_fn = _elide_covered_imask(imask_fn, pruned_segs, intervals)
+    # __time (int64, the widest column) is read only when something
+    # actually consumes raw timestamps on device: an un-elided interval
+    # mask, or bucketing/timeformat WITHOUT a cached derived id stream
+    # (the runner materializes cached streams once per table, so those
+    # kernels read [S,R] int32 ids instead of recomputing from millis)
+    need_time = ((bucket_plan.kind != "all"
+                  and bucket_plan.cache_token is None)
+                 or imask_fn is not None
+                 or any(dp.kind == "timeformat" and dp.cache_token is None
+                        for dp in dim_plans))
     columns, null_cols = _collect_columns(table, query, dim_plans, agg_plans,
                                           vexprs, need_time)
-    pruned = [s.meta.segment_id for s in table.prune(intervals)]
+    pruned = [s.meta.segment_id for s in pruned_segs]
 
     def _masked_key(env, valid, seg_mask, consts, xp, key_builder):
         flat = {c: a.reshape(-1) for c, a in env["cols"].items()}
@@ -407,7 +498,8 @@ def _lower_agg(query, table, config) -> PhysicalPlan:
         if bucket_plan.kind != "all":
             cached = flat.get(bucket_plan.derived_name) \
                 if bucket_plan.cache_token else None
-            ids.append(cached if cached is not None
+            ids.append(bucket_plan.ids_from_cached(cached, consts, xp)
+                       if cached is not None
                        else bucket_plan.ids(flat[TIME_COLUMN], consts))
             radix.append(sizes[0])
         for dp, size in zip(dim_plans, sizes[1:]):
@@ -556,6 +648,9 @@ def _lower_mask(query, table, config) -> PhysicalPlan:
     filter_fn = (compile_filter(query.filter, table, pool, vexprs)
                  if query.filter is not None else None)
     imask_fn = _interval_mask_fn(intervals, *table.time_boundary, pool)
+    pruned_segs = table.prune(
+        intervals, _filter_numeric_bounds(query.filter, table, vexprs))
+    imask_fn = _elide_covered_imask(imask_fn, pruned_segs, intervals)
 
     cols: set[str] = set()
     if query.filter is not None:
@@ -586,7 +681,7 @@ def _lower_mask(query, table, config) -> PhysicalPlan:
         return {"mask": mask}
 
     statics = ("mask", filter_fn is not None, imask_fn is not None)
-    pruned = [s.meta.segment_id for s in table.prune(intervals)]
+    pruned = [s.meta.segment_id for s in pruned_segs]
     return PhysicalPlan(
         query=query, table=table, kind="mask", pool=pool, kernel=kernel,
         statics=statics, pruned_ids=pruned, t_min=t_min, t_max=t_max,

@@ -430,28 +430,88 @@ class QueryRunner:
             lambda c: jnp.asarray(xmap)[c].astype(jnp.int32))(col)
 
     def _build_bucket_stream(self, ds, plan: PhysicalPlan):
-        """Calendar-granularity bucket ids [S, R] int32: the searchsorted
-        over every row is paid once per (table, boundary set), not per
-        dispatch."""
+        """Resident bucket stream [S, R] int32: the per-row pass
+        (searchsorted for calendar boundary sets, floor-divide for
+        uniform periods) is paid once per (table, token), not per
+        dispatch — and uniform tokens are table-anchored, so a sliding
+        query window re-uses the same stream (BucketPlan.build_stream /
+        ids_from_cached)."""
         col = ds.col(TIME_COLUMN)
         consts = plan.pool.consts
         if self.config.platform == "cpu":
-            return np.asarray(plan.bucket_plan.ids(np.asarray(col), consts),
-                              np.int32)
+            return np.asarray(
+                plan.bucket_plan.build_stream(np.asarray(col), consts),
+                np.int32)
         import jax
         import jax.numpy as jnp
 
         def f(c):
             cdev = {k: jnp.asarray(v) for k, v in consts.items()}
-            return plan.bucket_plan.ids(c, cdev).astype(jnp.int32)
+            return plan.bucket_plan.build_stream(c, cdev).astype(jnp.int32)
 
         return jax.jit(f)(col)
 
+    def _segment_window(self, plan: PhysicalPlan, n_segments: int):
+        """(lo, W) covering every pruned segment, or None. Interval
+        pruning is mask-only inside the kernel (pruned segments multiply
+        by zero but their bytes are still read); with time-partitioned
+        ingest the pruned set is contiguous on the segment axis, so the
+        dispatch dynamic-slices the [S, R] working set down to a pow2-
+        quantized window and reads ONLY those bytes — this is what turns
+        SURVEY.md §3.5 P4 pruning into real HBM savings. Skipped when a
+        mesh shards the segment axis (per-shard windows would need
+        divisibility), for mask-kind plans (the scan assembler indexes
+        the full axis), for Pallas plans (the kernel's grid floors
+        n // rb at its own row-block size, so a window that is not a
+        multiple of rb would silently drop rows — fuzz seed 78), and
+        when the window saves <25%."""
+        if self.mesh is not None or plan.empty or plan.kind == "mask" \
+                or plan.pallas_reason is None:
+            return None
+        ids = plan.pruned_ids
+        if not ids:
+            return None
+        lo, hi = min(ids), max(ids) + 1
+        W = _next_pow2(hi - lo)
+        if 4 * W >= 3 * n_segments:
+            return None
+        return min(lo, n_segments - W), W
+
+    @staticmethod
+    def _window_kernel(kernel, W: int):
+        """Wrap a partials kernel so the jitted program dynamic-slices
+        every [S, ...] input to [W, ...] at `lo` before compute. One
+        compile per (template, W); `lo` is traced, so interval changes
+        that keep the window size re-use the executable."""
+        import jax
+
+        def fn(env, valid, seg_mask, consts, lo):
+            def sl(a):
+                return jax.lax.dynamic_slice_in_dim(a, lo, W, axis=0)
+            wenv = {"cols": {c: sl(a) for c, a in env["cols"].items()},
+                    "nulls": {c: sl(a) for c, a in env["nulls"].items()}}
+            return kernel(wenv, sl(valid), sl(seg_mask), consts)
+        return fn
+
+    @staticmethod
+    def _window_numpy(env, valid, seg_mask, win):
+        lo, W = win
+        sl = slice(lo, lo + W)
+        wenv = {"cols": {c: a[sl] for c, a in env["cols"].items()},
+                "nulls": {c: a[sl] for c, a in env["nulls"].items()}}
+        return wenv, valid[sl], seg_mask[sl]
+
     def _run_partials(self, plan: PhysicalPlan, metrics: dict) -> dict:
         env, valid, seg_mask = self._prepare(plan, metrics)
+        win = self._segment_window(plan, len(seg_mask))
+        if win is not None:
+            metrics["segments_window"] = win[1]
 
         if self.config.platform == "cpu":
             t0 = time.perf_counter()
+            if win is not None:
+                env, valid, seg_mask = self._window_numpy(
+                    env, np.asarray(valid), seg_mask, win)
             out = plan.kernel(env, np.asarray(valid), seg_mask,
                               plan.pool.consts)
             metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
@@ -461,19 +521,23 @@ class QueryRunner:
 
         import jax
         mesh = self.mesh
-        key = plan.fingerprint() + ((mesh.devices.size,) if mesh else ())
+        key = plan.fingerprint() + ((mesh.devices.size,) if mesh else ()) \
+            + ((win[1],) if win else ())
         jitted = self._jit_cache.get(key)
         hit = jitted is not None
         if not hit:
             if mesh is not None:
                 from tpu_olap.executor.sharding import sharded_kernel
                 jitted = jax.jit(sharded_kernel(plan, mesh))
+            elif win is not None:
+                jitted = jax.jit(self._window_kernel(plan.kernel, win[1]))
             else:
                 jitted = jax.jit(plan.kernel)
             self._jit_cache[key] = jitted
         t0 = time.perf_counter()
         consts_dev, seg_arg = self._args_for(plan, seg_mask, mesh)
-        out = jitted(env, valid, seg_arg, consts_dev)
+        out = jitted(env, valid, seg_arg, consts_dev, win[0]) \
+            if win is not None else jitted(env, valid, seg_arg, consts_dev)
         out = {k: np.asarray(v) for k, v in out.items()}
         metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
         metrics["cache_hit"] = hit
@@ -510,15 +574,17 @@ class QueryRunner:
         return consts_dev, seg_arg
 
     def _packed_jit(self, plan: PhysicalPlan, cap: int, mesh,
-                    strategy: str = "historicals"):
+                    strategy: str = "historicals", win=None):
         """(jitted packed program, layout) for a given group cap.
         strategy "historicals" = shard_map explicit partials + ICI merge;
-        "broker" = whole program handed to GSPMD (planner.cost)."""
+        "broker" = whole program handed to GSPMD (planner.cost). `win`
+        appends the segment-window slice (single-device only)."""
         import jax
 
         layout = make_layout(plan, self.config, cap)
         key = plan.fingerprint() + ("packed", layout.cap, strategy,
-                                    mesh.devices.size if mesh else 1)
+                                    mesh.devices.size if mesh else 1) \
+            + ((win[1],) if win else ())
         jitted = self._jit_cache.get(key)
         if jitted is None:
             if mesh is not None and strategy == "historicals":
@@ -526,7 +592,10 @@ class QueryRunner:
                 inner = sharded_kernel(plan, mesh)
             else:
                 inner = plan.kernel
-            jitted = jax.jit(build_packer(inner, plan, layout))
+            packed = build_packer(inner, plan, layout)
+            if win is not None:
+                packed = self._window_kernel(packed, win[1])
+            jitted = jax.jit(packed)
             self._jit_cache[key] = jitted
             return jitted, layout, False
         return jitted, layout, True
@@ -540,6 +609,9 @@ class QueryRunner:
         the true group count exceeds the config cap (caller re-runs the
         unpacked per-array path)."""
         env, valid, seg_mask = self._prepare(plan, metrics)
+        win = self._segment_window(plan, len(seg_mask))
+        if win is not None:
+            metrics["segments_window"] = win[1]
         mesh = self.mesh
         strategy = "historicals"
         if mesh is not None:
@@ -556,8 +628,11 @@ class QueryRunner:
         t0 = time.perf_counter()
         consts_dev, seg_arg = self._args_for(plan, seg_mask, mesh)
         while True:
-            jitted, layout, hit = self._packed_jit(plan, cap, mesh, strategy)
-            buf = jitted(env, valid, seg_arg, consts_dev)
+            jitted, layout, hit = self._packed_jit(plan, cap, mesh,
+                                                   strategy, win)
+            buf = jitted(env, valid, seg_arg, consts_dev, win[0]) \
+                if win is not None else \
+                jitted(env, valid, seg_arg, consts_dev)
             count, idx, compact = unpack(buf, layout)
             if count <= layout.cap:
                 break
@@ -586,6 +661,9 @@ class QueryRunner:
         from tpu_olap.kernels.groupby import UnsupportedAggregation
 
         env, valid, seg_mask = self._prepare(plan, metrics)
+        win = self._segment_window(plan, len(seg_mask))
+        if win is not None:
+            metrics["segments_window"] = win[1]
         mesh = self.mesh
         n_shards = mesh.devices.size if mesh else 1
         base_key = plan.fingerprint() + ("sparse", n_shards)
@@ -604,6 +682,9 @@ class QueryRunner:
         t0 = time.perf_counter()
         hit = False
         if self.config.platform == "cpu":
+            if win is not None:
+                env, valid, seg_mask = self._window_numpy(
+                    env, np.asarray(valid), seg_mask, win)
             while True:
                 out = plan.make_sparse_kernel(cap)(
                     env, np.asarray(valid), seg_mask, plan.pool.consts)
@@ -621,7 +702,7 @@ class QueryRunner:
             import jax
             consts_dev, seg_arg = self._args_for(plan, seg_mask, mesh)
             while True:
-                key = base_key + (cap,)
+                key = base_key + (cap,) + ((win[1],) if win else ())
                 jitted = self._jit_cache.get(key)
                 hit = jitted is not None
                 if not hit:
@@ -631,10 +712,14 @@ class QueryRunner:
                             sharded_sparse_gather_kernel
                         jitted = jax.jit(sharded_sparse_gather_kernel(
                             kern, plan, mesh, cap))
+                    elif win is not None:
+                        jitted = jax.jit(self._window_kernel(kern, win[1]))
                     else:
                         jitted = jax.jit(kern)
                     self._jit_cache[key] = jitted
-                out = jitted(env, valid, seg_arg, consts_dev)
+                out = jitted(env, valid, seg_arg, consts_dev, win[0]) \
+                    if win is not None else \
+                    jitted(env, valid, seg_arg, consts_dev)
                 count = int(out["_count"])
                 if count <= cap:
                     break

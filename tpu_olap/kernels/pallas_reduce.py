@@ -761,9 +761,11 @@ def build_kernel(plan, table, config, filter_fn, interpret: bool,
             if has_buckets:
                 b = flat_env["cols"].get(bucket_plan.derived_name) \
                     if bucket_plan.cache_token else None
-                if b is None:
-                    b = bucket_plan.ids(flat_env["cols"][TIME_COLUMN],
-                                        consts)
+                # cached uniform streams are TABLE-anchored; rebase to
+                # this plan's origin bucket (timebucket.ids_from_cached)
+                b = bucket_plan.ids(flat_env["cols"][TIME_COLUMN],
+                                    consts) if b is None else \
+                    bucket_plan.ids_from_cached(b, consts, jnp)
                 pre_in.append(b.astype(jnp.int32).reshape(1, n))
             for dp, is_pre in zip(dim_plans, pre_dims):
                 if is_pre:

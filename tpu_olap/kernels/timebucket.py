@@ -36,11 +36,16 @@ class BucketPlan:
     origin_name: str | None = None
     step_name: str | None = None
     boundaries_name: str | None = None
-    # content hash for the "boundaries" kind: a per-dispatch searchsorted
-    # over every row is the calendar-granularity hot cost; the runner
-    # caches the resulting id stream as a device-resident derived column
-    # keyed by this token (same machinery as remap dims)
+    # The runner caches the bucket id stream as a device-resident
+    # derived column keyed by this token (same machinery as remap
+    # dims), saving both the per-dispatch id compute (searchsorted for
+    # "boundaries") and the int64 __time read. "uniform" streams are
+    # TABLE-anchored — token u:<phase>:<step> is independent of the
+    # query's time range, so a sliding dashboard window re-uses one
+    # resident stream; ids_from_cached() rebases to the query's origin.
     cache_token: str | None = None
+    phase_name: str | None = None          # uniform: origin mod step
+    origin_bucket_name: str | None = None  # uniform: (origin-phase)/step
 
     @property
     def derived_name(self) -> str | None:
@@ -59,9 +64,59 @@ class BucketPlan:
         i = xp.searchsorted(bs, time, side="right") - 1
         return xp.clip(i, 0, self.n_buckets - 1).astype(xp.int32)
 
+    def build_stream(self, time, consts):
+        """The cacheable per-row stream [same shape as time], int32.
+        "uniform": table-anchored bucket index (t - phase) // step;
+        "boundaries": the query-range ids themselves (the boundary set
+        is the token, so the stream is exact for that token)."""
+        xp = jnp if not isinstance(time, np.ndarray) else np
+        if self.kind == "uniform":
+            return ((time - consts[self.phase_name])
+                    // consts[self.step_name]).astype(xp.int32)
+        return self.ids(time, consts)
+
+    def ids_from_cached(self, cached, consts, xp):
+        """Query-range ids from a resident stream: rebase the table-
+        anchored uniform index to this plan's origin bucket and clip
+        (same out-of-range clamp semantics as ids() — callers mask)."""
+        if self.kind == "uniform":
+            i = cached - consts[self.origin_bucket_name]
+            return xp.clip(i, 0, self.n_buckets - 1).astype(xp.int32)
+        return cached
+
+
+def _uniform_plan(origin: int, step: int, n: int, pool,
+                  table_bounds) -> BucketPlan:
+    """Uniform BucketPlan with a TABLE-anchored cacheable stream: the
+    token depends only on (phase, step) — phase = origin mod step is the
+    same for every query range of this granularity — so a sliding
+    dashboard window re-uses one resident stream instead of rebuilding a
+    full-table id pass per distinct time range. Caching is skipped when
+    the table-anchored index could overflow int32 (sub-second steps over
+    decades) or the table bounds are unknown."""
+    starts = origin + step * np.arange(n, dtype=np.int64)
+    phase = origin % step
+    token = None
+    phase_name = origin_bucket_name = None
+    if table_bounds is not None:
+        t_lo, t_hi = table_bounds
+        lo_idx = (t_lo - phase) // step
+        hi_idx = (t_hi - phase) // step
+        if -(2 ** 31) < lo_idx and hi_idx < 2 ** 31 - 1 \
+                and -(2 ** 31) < (origin - phase) // step < 2 ** 31 - 1:
+            token = f"u:{phase}:{step}"
+            phase_name = pool.add(phase, np.int64)
+            origin_bucket_name = pool.add(
+                np.int32((origin - phase) // step), np.int32)
+    return BucketPlan(n, starts, "uniform",
+                      origin_name=pool.add(origin, np.int64),
+                      step_name=pool.add(step, np.int64),
+                      cache_token=token, phase_name=phase_name,
+                      origin_bucket_name=origin_bucket_name)
+
 
 def compile_granularity(gran: Granularity, t_min: int, t_max: int,
-                        pool) -> BucketPlan:
+                        pool, table_bounds=None) -> BucketPlan:
     """t_min/t_max: inclusive millis range actually queried (intervals ∩
     table time boundary). pool: ConstPool for device constants."""
     if isinstance(gran, AllGranularity):
@@ -76,10 +131,7 @@ def compile_granularity(gran: Granularity, t_min: int, t_max: int,
             raise UnsupportedGranularity("duration must be positive")
         origin = gran.origin + ((t_min - gran.origin) // step) * step
         n = int((t_max - origin) // step) + 1
-        starts = origin + step * np.arange(n, dtype=np.int64)
-        return BucketPlan(n, starts, "uniform",
-                          origin_name=pool.add(origin, np.int64),
-                          step_name=pool.add(step, np.int64))
+        return _uniform_plan(origin, step, n, pool, table_bounds)
     if isinstance(gran, PeriodGranularity):
         if gran.origin is not None:
             # explicit origin pins alignment: pure epoch stepping, but only
@@ -92,10 +144,7 @@ def compile_granularity(gran: Granularity, t_min: int, t_max: int,
             step = timeutil.period_millis(gran.period)
             origin = gran.origin + ((t_min - gran.origin) // step) * step
             n = int((t_max - origin) // step) + 1
-            starts = origin + step * np.arange(n, dtype=np.int64)
-            return BucketPlan(n, starts, "uniform",
-                              origin_name=pool.add(origin, np.int64),
-                              step_name=pool.add(step, np.int64))
+            return _uniform_plan(origin, step, n, pool, table_bounds)
         if gran.is_uniform():
             step = timeutil.period_millis(gran.period)
             # natural alignment: floor t_min to the local period start
@@ -103,10 +152,11 @@ def compile_granularity(gran: Granularity, t_min: int, t_max: int,
                                               t_min, t_min)
             origin = bs[0]
             n = int((t_max - origin) // step) + 1
-            starts = origin + step * np.arange(n, dtype=np.int64)
-            return BucketPlan(n, starts, "uniform",
-                              origin_name=pool.add(origin, np.int64),
-                              step_name=pool.add(step, np.int64))
+            # resident id stream like the boundaries kind: the id
+            # arithmetic is trivial but caching it drops the __time
+            # (int64) read from every dispatch that needs no other
+            # raw-timestamp consumer (executor/lowering.py need_time)
+            return _uniform_plan(origin, step, n, pool, table_bounds)
         bs = np.asarray(timeutil.calendar_boundaries(
             gran.period, gran.time_zone, t_min, t_max), np.int64)
         n = len(bs) - 1

@@ -180,6 +180,46 @@ def _convert_column(arr, n: int):
 # --------------------------------------------------------------------------
 # Streaming ingestor
 
+_PARTITION_UNIT = {"day": "D", "month": "M", "year": "Y"}
+
+
+def _partition_ids(t_ms: np.ndarray, granularity: str) -> np.ndarray:
+    """Calendar partition index per row (UTC, like Druid's default
+    segmentGranularity bucketing) from epoch-millis int64."""
+    return t_ms.astype("datetime64[ms]") \
+        .astype(f"datetime64[{_PARTITION_UNIT[granularity]}]") \
+        .astype(np.int64)
+
+
+MAX_AUTO_PARTITIONS = 128
+
+
+def resolve_time_partition(spec, t_min, t_max, total_rows: int,
+                           block_rows: int):
+    """Resolve "auto" to the finest calendar granularity whose expected
+    partition count stays ≤ min(total_blocks/4, MAX_AUTO_PARTITIONS) —
+    ≥ ~4 full blocks per partition bounds the finalize padding (≤ one
+    partial block per partition) at roughly 12%, and the absolute cap
+    bounds the streaming ingestor's per-partition remainder buffers
+    (≤ one block each) so the bounded-host-memory invariant of
+    SURVEY.md §8.4 #4 holds at any scale. Falls back to None (no
+    partitioning) for tables too small to amortize even yearly
+    partitions."""
+    if spec != "auto":
+        return spec
+    if t_min is None or t_max is None or t_max <= t_min or not total_rows:
+        return None
+    budget = min(max(1, total_rows // block_rows) / 4,
+                 MAX_AUTO_PARTITIONS)
+    span_ms = t_max - t_min
+    for g, unit_ms in (("day", 86_400_000),
+                       ("month", 2_629_800_000),
+                       ("year", 31_557_600_000)):
+        if span_ms / unit_ms <= budget:
+            return g
+    return None
+
+
 class StreamIngestor:
     """Accumulates converted batches into fixed-size segment blocks.
 
@@ -187,19 +227,41 @@ class StreamIngestor:
     plus one in-flight batch of decoded Arrow data; raw strings never
     outlive their batch. Rows are time-sorted within each flush chunk
     (not globally — per-segment time_min/max stay exact for pruning, like
-    Druid segments, which are interval-partitioned but not row-sorted)."""
+    Druid segments, which are interval-partitioned but not row-sorted).
+
+    `time_partition` ("day"/"month"/"year") is the Druid
+    segmentGranularity analog: rows bucket into disjoint calendar
+    partitions, each accumulating its own blocks, so segment time ranges
+    never straddle a partition boundary. That is what makes interval
+    pruning drop whole segments on time-filtered queries over streamed
+    (unsorted) sources, and what lets the lowering elide the residual
+    row-level interval mask — and with it the 8-bytes/row __time scan
+    traffic — when every scanned segment sits inside one query interval
+    (executor/lowering.py::_elide_covered_imask). Cost: up to one
+    padded partial block per partition, emitted at finalize."""
 
     def __init__(self, name: str, time_column: str | None = None,
-                 block_rows: int = DEFAULT_BLOCK_ROWS):
+                 block_rows: int = DEFAULT_BLOCK_ROWS,
+                 time_partition: str | None = None):
+        if time_partition is not None \
+                and time_partition not in _PARTITION_UNIT:
+            raise ValueError(
+                f"time_partition must be one of {sorted(_PARTITION_UNIT)}"
+                " or None")
         self.name = name
         self.time_column = time_column
         self.block_rows = block_rows
+        self.time_partition = time_partition
         self.schema: dict | None = None
         self._dicts: dict[str, DictBuilder] = {}
         self._segments: list[Segment] = []
         self._pending: list[dict] = []      # per-batch {col: values}
         self._pending_nulls: list[dict] = []
         self._pending_rows = 0
+        # per-partition accumulators (time_partition only)
+        self._pbuf: dict[int, list[dict]] = {}
+        self._pbuf_nulls: dict[int, list[dict]] = {}
+        self._pbuf_rows: dict[int, int] = {}
         self._finalized = False
 
     # ---- batch intake ----------------------------------------------------
@@ -281,18 +343,64 @@ class StreamIngestor:
 
     # ---- block emission --------------------------------------------------
 
-    def _flush(self, rows: int) -> None:
-        """Emit full blocks from the first `rows` pending rows (the chunk
-        is time-sorted first); the remainder is carried forward."""
-        cat = {c: np.concatenate([p[c] for p in self._pending])
-               for c in self._pending[0]}
-        nset = set().union(*(n.keys() for n in self._pending_nulls)) \
-            if self._pending_nulls else set()
+    @staticmethod
+    def _cat_pieces(pieces, npieces):
+        """Concatenate buffered column pieces + zero-backfilled null
+        masks (a piece that predates a column's first null has no mask
+        entry). Shared by the pending drain and partition emission."""
+        cat = {c: np.concatenate([p[c] for p in pieces])
+               for c in pieces[0]}
+        nset = set().union(*(n.keys() for n in npieces)) \
+            if npieces else set()
         cat_nulls = {}
         for c in nset:
             cat_nulls[c] = np.concatenate([
                 n.get(c, np.zeros(len(p[TIME_COLUMN]), bool))
-                for p, n in zip(self._pending, self._pending_nulls)])
+                for p, n in zip(pieces, npieces)])
+        return cat, cat_nulls
+
+    def _cat_pending(self):
+        return self._cat_pieces(self._pending, self._pending_nulls)
+
+    def _flush(self, rows: int) -> None:
+        """Emit full blocks from the first `rows` pending rows (the chunk
+        is time-sorted first); the remainder is carried forward. With
+        time_partition set, ALL pending rows instead drain into their
+        calendar partition's accumulator, and each partition emits its
+        own full blocks (remainders live in the partition buffers until
+        finalize)."""
+        if self.time_partition is not None:
+            cat, cat_nulls = self._cat_pending()
+            self._pending, self._pending_nulls = [], []
+            self._pending_rows = 0
+            order = np.argsort(cat[TIME_COLUMN], kind="stable")
+            pids = _partition_ids(cat[TIME_COLUMN][order],
+                                  self.time_partition)
+            cuts = np.flatnonzero(np.diff(pids)) + 1
+            bounds = np.concatenate([[0], cuts, [len(pids)]])
+            for s, e in zip(bounds[:-1], bounds[1:]):
+                if s == e:
+                    continue
+                pid = int(pids[s])
+                idx = order[s:e]
+                self._pbuf.setdefault(pid, []).append(
+                    {c: v[idx] for c, v in cat.items()})
+                self._pbuf_nulls.setdefault(pid, []).append(
+                    {c: m[idx] for c, m in cat_nulls.items()})
+                self._pbuf_rows[pid] = self._pbuf_rows.get(pid, 0) \
+                    + (e - s)
+                if self._pbuf_rows[pid] >= self.block_rows:
+                    self._emit_partition(pid, final=False)
+            # hard cap on total buffered remainders (bounded host
+            # memory even under an explicitly fine granularity on a
+            # huge span): force-emit the largest buffers as padded
+            # partials — a little block padding, never an OOM
+            budget = MAX_AUTO_PARTITIONS * self.block_rows
+            while sum(self._pbuf_rows.values()) > budget:
+                pid = max(self._pbuf_rows, key=self._pbuf_rows.get)
+                self._emit_partition(pid, final=True)
+            return
+        cat, cat_nulls = self._cat_pending()
 
         order = np.argsort(cat[TIME_COLUMN][:rows], kind="stable")
         n_blocks = rows // self.block_rows if rows >= self.block_rows else 1
@@ -314,6 +422,34 @@ class StreamIngestor:
             self._pending = []
             self._pending_nulls = []
         self._pending_rows -= emit
+
+    def _emit_partition(self, pid: int, final: bool) -> None:
+        """Emit this partition's full blocks (all rows incl. a padded
+        partial when final); the remainder rows stay buffered. Rows are
+        re-time-sorted across the buffered pieces so blocks inside a
+        partition stay locally sorted."""
+        cat, cat_nulls = self._cat_pieces(self._pbuf[pid],
+                                          self._pbuf_nulls[pid])
+        rows = self._pbuf_rows[pid]
+        emit = rows if final else rows - rows % self.block_rows
+        order = np.argsort(cat[TIME_COLUMN], kind="stable")
+        pos = 0
+        while pos < emit:
+            hi = min(pos + self.block_rows, emit)
+            idx = order[pos:hi]
+            self._emit_block({c: v[idx] for c, v in cat.items()},
+                             {c: m[idx] for c, m in cat_nulls.items()},
+                             hi - pos)
+            pos = hi
+        if final or emit == rows:
+            del self._pbuf[pid], self._pbuf_nulls[pid], \
+                self._pbuf_rows[pid]
+        else:
+            rest = order[emit:]
+            self._pbuf[pid] = [{c: v[rest] for c, v in cat.items()}]
+            self._pbuf_nulls[pid] = [{c: m[rest]
+                                      for c, m in cat_nulls.items()}]
+            self._pbuf_rows[pid] = rows - emit
 
     def _emit_block(self, vals: dict, nulls: dict, nv: int) -> None:
         cols, masks = {}, {}
@@ -354,17 +490,30 @@ class StreamIngestor:
     def finalize(self) -> TableSegments:
         assert not self._finalized, "finalize() called twice"
         self._finalized = True
-        if self._pending_rows or not self._segments:
-            if not self._pending_rows and not self._segments:
-                # empty table: one empty segment keeps shapes non-degenerate
-                if self.schema is None:
-                    self.schema = {TIME_COLUMN: ColumnType.LONG}
-                self._emit_block(
-                    {c: np.zeros(0, np.int64 if t is not ColumnType.DOUBLE
-                                 else np.float64)
-                     for c, t in self.schema.items()}, {}, 0)
-            elif self._pending_rows:
-                self._flush(self._pending_rows)
+        if self._pending_rows:
+            self._flush(self._pending_rows)
+        for pid in sorted(self._pbuf):  # partition remainders, padded
+            self._emit_partition(pid, final=True)
+        if self.time_partition is not None and len(self._segments) > 1:
+            # partition-contiguous id order: arrival-order emission and
+            # the finalize partials interleave partitions, but each
+            # segment lies inside ONE partition, so sorting by time_min
+            # makes every partition a contiguous id run — which is what
+            # lets the dispatcher's segment-window slice (runner.
+            # _segment_window) cover a pruned interval with a tight
+            # window instead of the whole store
+            self._segments.sort(
+                key=lambda s: (s.meta.time_min, s.meta.segment_id))
+            for i, s in enumerate(self._segments):
+                s.meta.segment_id = i
+        if not self._segments:
+            # empty table: one empty segment keeps shapes non-degenerate
+            if self.schema is None:
+                self.schema = {TIME_COLUMN: ColumnType.LONG}
+            self._emit_block(
+                {c: np.zeros(0, np.int64 if t is not ColumnType.DOUBLE
+                             else np.float64)
+                 for c, t in self.schema.items()}, {}, 0)
 
         # sorted-dictionary remap for stored temp codes
         dictionaries: dict = {}
@@ -409,44 +558,87 @@ class StreamIngestor:
 # Entry points
 
 def ingest_arrow(name: str, table, time_column: str | None = None,
-                 block_rows: int = DEFAULT_BLOCK_ROWS) -> TableSegments:
-    """In-memory ingest: globally time-sorted segments."""
-    ing = StreamIngestor(name, time_column, block_rows)
+                 block_rows: int = DEFAULT_BLOCK_ROWS,
+                 time_partition="auto") -> TableSegments:
+    """In-memory ingest: globally time-sorted segments, partition-
+    aligned per the resolved time_partition (segmentGranularity)."""
     if time_column is None and TIME_COLUMN in table.schema.names:
         time_column = TIME_COLUMN
+    tvals = None
     if time_column is not None and table.num_rows:
         tvals = _convert_time(table.column(time_column), table.num_rows)
         order = np.argsort(tvals, kind="stable")
         if not np.array_equal(order, np.arange(table.num_rows)):
             table = table.take(order)
+            tvals = tvals[order]
+    tp = resolve_time_partition(
+        time_partition,
+        int(tvals[0]) if tvals is not None and len(tvals) else None,
+        int(tvals[-1]) if tvals is not None and len(tvals) else None,
+        table.num_rows, block_rows)
+    ing = StreamIngestor(name, time_column, block_rows, tp)
     ing.add_arrow(table)
     return ing.finalize()
 
 
 def ingest_pandas(name: str, df, time_column: str | None = None,
-                  block_rows: int = DEFAULT_BLOCK_ROWS) -> TableSegments:
+                  block_rows: int = DEFAULT_BLOCK_ROWS,
+                  time_partition="auto") -> TableSegments:
     import pyarrow as pa
     return ingest_arrow(name, pa.Table.from_pandas(df, preserve_index=False),
-                        time_column, block_rows)
+                        time_column, block_rows, time_partition)
 
 
 def ingest_parquet(name: str, path, time_column: str | None = None,
                    block_rows: int = DEFAULT_BLOCK_ROWS,
                    columns=None, column_map: dict | None = None,
-                   batch_rows: int | None = None) -> TableSegments:
+                   batch_rows: int | None = None,
+                   time_partition="auto") -> TableSegments:
     """Streaming parquet ingest; `path` may be one path or a list."""
     return ingest_parquet_stream(name, path, time_column, block_rows,
-                                 columns, column_map, batch_rows)
+                                 columns, column_map, batch_rows,
+                                 time_partition)
+
+
+def _parquet_time_stats(paths, time_col):
+    """(t_min_ms, t_max_ms, total_rows) from parquet row-group footer
+    statistics — metadata only, no data read. (None, None, rows) when
+    any row group lacks stats for the time column."""
+    import pyarrow.parquet as pq
+    lo = hi = None
+    rows = 0
+    for path in paths:
+        md = pq.ParquetFile(path).metadata
+        rows += md.num_rows
+        try:
+            sidx = md.schema.names.index(time_col)
+        except ValueError:
+            return None, None, rows
+        for rg in range(md.num_row_groups):
+            st = md.row_group(rg).column(sidx).statistics
+            if st is None or not st.has_min_max:
+                return None, None, rows
+            mn, mx = st.min, st.max
+            if hasattr(mn, "timestamp"):
+                mn = int(mn.timestamp() * 1000)
+                mx = int(mx.timestamp() * 1000)
+            elif not isinstance(mn, (int, np.integer)):
+                return None, None, rows
+            lo = mn if lo is None else min(lo, mn)
+            hi = mx if hi is None else max(hi, mx)
+    return lo, hi, rows
 
 
 def ingest_parquet_stream(name: str, paths, time_column: str | None = None,
                           block_rows: int = DEFAULT_BLOCK_ROWS,
                           columns=None, column_map: dict | None = None,
-                          batch_rows: int | None = None) -> TableSegments:
+                          batch_rows: int | None = None,
+                          time_partition="auto") -> TableSegments:
     """Row-group streaming ingest over one or many parquet files under
     bounded host memory (SURVEY.md §8.4 #4 / BASELINE.json:5 "streams
     Parquet→HBM"). `columns` / `column_map` use POST-rename names, like
-    Engine.register_table."""
+    Engine.register_table. time_partition="auto" resolves the Druid
+    segmentGranularity analog from the footer's time statistics."""
     import pyarrow.parquet as pq
 
     if isinstance(paths, str):
@@ -455,7 +647,15 @@ def ingest_parquet_stream(name: str, paths, time_column: str | None = None,
     inverse = {v: k for k, v in (column_map or {}).items()}
     read_cols = [inverse.get(c, c) for c in columns] if columns else None
 
-    ing = StreamIngestor(name, time_column, block_rows)
+    if time_partition == "auto" and time_column is not None:
+        src_time = inverse.get(time_column, time_column)
+        t_lo, t_hi, n_rows = _parquet_time_stats(paths, src_time)
+        time_partition = resolve_time_partition(
+            "auto", t_lo, t_hi, n_rows, block_rows)
+    elif time_partition == "auto":
+        time_partition = None
+
+    ing = StreamIngestor(name, time_column, block_rows, time_partition)
     bs = batch_rows or block_rows
     dict_cols = None   # string columns read as arrow dictionaries
     for path in paths:
