@@ -705,3 +705,33 @@ def test_extraction_bound_filter_rewrites():
         fb = execute_fallback(eng.planner.plan(sql).stmt, eng.catalog,
                               eng.config)
         assert int(fb["n"][0]) == oracle
+
+
+def test_derived_table_inner_rides_device_path():
+    """Round 5 (soak r05: 100% of fuzz fallbacks were derived-table
+    statements): a FROM/JOIN (SELECT ...) body that is device-rewritable
+    executes through the statement executor — the scan-heavy inner
+    aggregate rides the device path, the outer interpreter consumes the
+    small materialized frame."""
+    eng, df = _engine()
+    n0 = len(eng.history)
+    got = eng.sql("SELECT avg(s) AS a, count(*) AS n FROM "
+                  "(SELECT g, sum(v) AS s FROM t WHERE v < 900 "
+                  "GROUP BY g) d WHERE s > 0")
+    assert len(eng.history) > n0, "inner did not dispatch to the device"
+    sub = df[df.v < 900].groupby("g")["v"].sum()
+    sub = sub[sub > 0]
+    assert abs(float(got["a"].iloc[0]) - sub.mean()) < 1e-9
+    assert int(got["n"].iloc[0]) == len(sub)
+
+    n1 = len(eng.history)
+    got2 = eng.sql(
+        "SELECT g, sum(v) AS tv, max(ds) AS m FROM t "
+        "JOIN (SELECT g AS dg, sum(v) AS ds FROM t GROUP BY g) d "
+        "ON g = dg GROUP BY g ORDER BY g LIMIT 5")
+    assert len(eng.history) > n1
+    base = df.groupby("g")["v"].sum().reset_index()
+    exp = base.assign(m=base.g.map(df.groupby("g")["v"].sum())) \
+        .sort_values("g").head(5)
+    assert list(got2["tv"]) == list(exp["v"])
+    assert list(got2["m"]) == list(exp["m"])

@@ -1,0 +1,114 @@
+"""Bench the GROUPING SETS / ROLLUP / CUBE device-union path (VERDICT
+r4 missing #4 "and a bench number") against the whole-statement pandas
+fallback on the cached SSB dataset. Banks BENCH_GSETS.json.
+
+Usage: python tools/bench_gsets.py   [GSETS_ROWS=6000000 GSETS_ITERS=5]
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+QUERIES = {
+    "rollup2": "SELECT brand, dyear, sum(revenue) AS rev, count(*) AS n "
+               "FROM ssb GROUP BY ROLLUP(brand, dyear)",
+    "cube2": "SELECT region, dyear, sum(revenue) AS rev "
+             "FROM ssb GROUP BY CUBE(region, dyear)",
+    "gsets3": "SELECT brand, region, dyear, sum(revenue) AS rev "
+              "FROM ssb GROUP BY GROUPING SETS "
+              "((brand, dyear), (region), ())",
+}
+
+
+def main():
+    from tpu_olap.utils.platform import env_flag, force_cpu_platform
+    if env_flag("BENCH_FORCE_CPU") or os.environ.get("JAX_PLATFORMS"):
+        force_cpu_platform()
+    import importlib.util
+
+    import numpy as np
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    from tpu_olap import Engine
+    from tpu_olap.planner.fallback import execute_fallback
+
+    rows = int(os.environ.get("GSETS_ROWS", 6_000_000))
+    iters = int(os.environ.get("GSETS_ITERS", 5))
+    paths, dims = bench._prepare_dataset(rows, 0)
+    eng = Engine()
+    # one flat table with the grouping columns materialized (the union
+    # path decomposes per set; star-join collapse is bench.py's job)
+    import pandas as pd
+    lo = pd.concat([pd.read_parquet(p) for p in paths[:2]],
+                   ignore_index=True)
+    part = dims["part"][["p_partkey", "p_brand1"]]
+    supp = dims["supplier"][["s_suppkey", "s_region"]]
+    date = dims["date"][["d_datekey", "d_year"]]
+    lo = lo.merge(part, left_on="lo_partkey", right_on="p_partkey") \
+           .merge(supp, left_on="lo_suppkey", right_on="s_suppkey") \
+           .merge(date, left_on="lo_orderdate", right_on="d_datekey")
+    df = pd.DataFrame({
+        "ts": pd.to_datetime(lo["d_year"].astype(str)),
+        "brand": lo["p_brand1"].astype(str),
+        "region": lo["s_region"].astype(str),
+        "dyear": lo["d_year"].astype(np.int64),
+        "revenue": lo["lo_revenue"].astype(np.int64),
+    })
+    eng.register_table("ssb", df, time_column="ts")
+
+    import jax
+    backend = jax.devices()[0].platform
+
+    out = {"rows": len(df), "iters": iters, "backend": backend,
+           "per_query": {}}
+    for name, sql in QUERIES.items():
+        eng.sql(sql)  # warm compile caches
+        plan = eng.last_plan
+        legs = getattr(plan, "grouping_legs", None)
+        n_dev = sum(1 for lp in legs if lp.rewritten) if legs else 0
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            eng.sql(sql)
+            times.append((time.perf_counter() - t0) * 1000)
+        fb_times = []
+        stmt = eng.planner.plan(sql).stmt
+        # pure-interpreter baseline: keep derived/inner statements OFF
+        # the device so the comparison is fallback-vs-device, not
+        # device-vs-device
+        import dataclasses
+        pure_cfg = dataclasses.replace(eng.config,
+                                       fallback_derived_on_device=False)
+        for _ in range(max(2, iters // 2)):
+            t0 = time.perf_counter()
+            execute_fallback(stmt, eng.catalog, pure_cfg)
+            fb_times.append((time.perf_counter() - t0) * 1000)
+        import numpy as np
+        dev_p50 = round(float(np.percentile(times, 50)), 1)
+        fb_p50 = round(float(np.percentile(fb_times, 50)), 1)
+        out["per_query"][name] = {
+            "union_p50_ms": dev_p50, "fallback_p50_ms": fb_p50,
+            "speedup": round(fb_p50 / dev_p50, 2) if dev_p50 else None,
+            "legs": len(legs) if legs else 0,
+            "legs_device": n_dev,
+        }
+        print(f"[gsets] {name}: union {dev_p50}ms vs fallback {fb_p50}ms "
+              f"({n_dev}/{len(legs) if legs else 0} legs on device)",
+              file=sys.stderr, flush=True)
+    out["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(os.path.join(REPO, "BENCH_GSETS.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"ok": True, **{k: v["speedup"]
+                                     for k, v in out["per_query"].items()}}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,6 +44,12 @@ class Engine:
         # inline as literals so the outer query can push down; the inner
         # aggregate itself rides the device path when rewritable)
         self.planner.run_subquery = self._run_stmt
+        # fallback-initiated derived-table execution (round 5): a FROM/
+        # JOIN (SELECT ...) body is usually the scan-heavy, device-
+        # eligible part of a statement the outer interpreter serves —
+        # route it back through the statement executor so the inner
+        # aggregate rides the device path (fallback._run_inner_stmt)
+        self.catalog.device_runner = self._run_stmt
 
     # ------------------------------------------------------- registration
 
@@ -51,7 +57,7 @@ class Engine:
                        star_schema=None, accelerate: bool = True,
                        block_rows: int = DEFAULT_BLOCK_ROWS,
                        column_map: dict | None = None,
-                       columns=None, **options):
+                       columns=None, time_partition="auto", **options):
         """Register a datasource. `data`: pandas DataFrame, pyarrow Table,
         parquet path, or a list of parquet paths (a multi-file dataset).
         accelerate=False registers a plain (dimension) table served only
@@ -64,6 +70,14 @@ class Engine:
         optionally prunes the ingested column set — always POST-rename
         names (after column_map), for every input type; parquet reads
         skip pruned columns entirely.
+
+        `time_partition` is the Druid segmentGranularity analog:
+        "day"/"month"/"year" buckets rows into disjoint calendar
+        partitions (interval pruning then drops whole segments, and the
+        residual row-level time mask — with its 8-bytes/row __time scan
+        traffic — elides when every scanned segment sits inside the
+        query interval); "auto" (default) picks the finest granularity
+        the table can amortize; None disables partitioning.
         """
         column_map = dict(column_map) if column_map else None
         if column_map and time_column in column_map:
@@ -97,7 +111,8 @@ class Engine:
             if accelerate:
                 segments = ingest_parquet_stream(
                     name, paths, time_column, block_rows,
-                    columns=columns, column_map=column_map)
+                    columns=columns, column_map=column_map,
+                    time_partition=time_partition)
             frame_source = load_frame
             pq_fields = dict(
                 parquet_paths=tuple(paths),
@@ -124,7 +139,8 @@ class Engine:
                 return _t.to_pandas()
 
         if accelerate and segments is None:
-            segments = ingest_arrow(name, table, time_column, block_rows)
+            segments = ingest_arrow(name, table, time_column, block_rows,
+                                    time_partition=time_partition)
         star = star_schema
         if isinstance(star, dict):
             star = StarSchema.from_json(star)

@@ -31,6 +31,16 @@ def _f64(s: pd.Series) -> np.ndarray:
     return s.to_numpy(dtype=np.float64)
 
 
+def pure_config(config):
+    """The oracle-side config: derived-table bodies stay on the pandas
+    interpreter so the fallback is an INDEPENDENT execution — never a
+    re-run of the device path it is checking."""
+    import dataclasses
+    if not getattr(config, "fallback_derived_on_device", False):
+        return config
+    return dataclasses.replace(config, fallback_derived_on_device=False)
+
+
 def run_both(engine, sql: str):
     """Execute `sql` on the accelerated path AND the fallback interpreter.
     Returns (device_df, fallback_df, plan). Raises if the planner did not
@@ -40,7 +50,8 @@ def run_both(engine, sql: str):
     if not plan.rewritten:
         raise ParityError(
             f"query did not stay on the device path: {plan.fallback_reason}")
-    fb = execute_fallback(plan.stmt, engine.catalog, engine.config)
+    fb = execute_fallback(plan.stmt, engine.catalog,
+                          pure_config(engine.config))
     return device, fb, plan
 
 

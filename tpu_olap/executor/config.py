@@ -97,6 +97,12 @@ class EngineConfig:
     # inherit a held allocator lock).
     fallback_parallel_workers: int = 0
     fallback_parallel_timeout_s: float = 900.0
+    # FROM/JOIN (SELECT ...) bodies route back through the engine's
+    # statement executor (device path when rewritable). False keeps the
+    # interpreter pure — bench.parity.pure_config() derives that oracle
+    # config, and run_both uses it so the fallback side of every parity
+    # check stays an independent pandas execution.
+    fallback_derived_on_device: bool = True
 
     # execution platform: "device" = default jax backend, "cpu" = numpy path
     platform: str = "device"

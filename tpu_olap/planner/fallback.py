@@ -34,6 +34,35 @@ class FallbackError(Exception):
     pass
 
 
+def _run_inner_stmt(s, catalog, config) -> pd.DataFrame:
+    """Execute a derived-table body: through the engine's statement
+    executor when the catalog carries one (device path for rewritable
+    inner aggregates — the reference's split: Spark consumed the
+    subquery result, the rewritten inner pushed to Druid, SURVEY.md
+    §3.1; soak r05 showed 100% of fuzz fallbacks were derived-table
+    statements whose inner scans are exactly the device-eligible part),
+    else the pandas interpreter."""
+    runner = getattr(catalog, "device_runner", None)
+    if runner is not None and config.fallback_derived_on_device:
+        df = runner(s)
+        # device frames render NULL numeric aggregates as None inside
+        # object columns; the interpreter's predicate evaluation (like
+        # pandas aggregation itself) expects float64 + NaN — normalize
+        # any all-numeric object column the way pandas would have
+        # produced it, so `WHERE m > 0` over a nullable max() keeps
+        # working (the "never an error" property, SURVEY.md §2 prop 2)
+        for c in df.columns:
+            if df[c].dtype == object:
+                vals = df[c][df[c].notna()]
+                if len(vals) < len(df[c]) and len(vals) and all(
+                        isinstance(v, (int, float, np.integer,
+                                       np.floating))
+                        for v in vals):
+                    df[c] = pd.to_numeric(df[c], errors="coerce")
+        return df
+    return execute_fallback(s, catalog, config)
+
+
 def execute_fallback(stmt, catalog, config) -> pd.DataFrame:
     if isinstance(stmt, UnionStmt):
         return _execute_union(stmt, catalog, config)
@@ -43,7 +72,7 @@ def execute_fallback(stmt, catalog, config) -> pd.DataFrame:
         # Its scope is its own — reject outer-table qualifiers inside
         # the body (they would strip onto the inner frame silently).
         _check_uncorrelated(stmt.derived)
-        df = execute_fallback(stmt.derived, catalog, config)
+        df = _run_inner_stmt(stmt.derived, catalog, config)
         time_col = None
     else:
         entry = catalog.get(stmt.table)
@@ -797,7 +826,7 @@ def _join_and_filter(stmt, df, catalog, time_col, config,
             # derived tables cannot see the outer row in standard SQL)
             if id(j) not in derived_frames:
                 _check_uncorrelated(j.derived)
-                derived_frames[id(j)] = execute_fallback(
+                derived_frames[id(j)] = _run_inner_stmt(
                     j.derived, catalog, config)
             return derived_frames[id(j)]
         return catalog.get(j.table).frame
