@@ -46,16 +46,12 @@ def main():
     # one flat table with the grouping columns materialized (the union
     # path decomposes per set; star-join collapse is bench.py's job)
     import pandas as pd
-    lo = pd.concat([pd.read_parquet(p) for p in paths[:2]],
+    cols = ["lo_orderdate_ts", "p_brand1", "s_region", "d_year",
+            "lo_revenue"]
+    lo = pd.concat([pd.read_parquet(p, columns=cols) for p in paths[:2]],
                    ignore_index=True)
-    part = dims["part"][["p_partkey", "p_brand1"]]
-    supp = dims["supplier"][["s_suppkey", "s_region"]]
-    date = dims["date"][["d_datekey", "d_year"]]
-    lo = lo.merge(part, left_on="lo_partkey", right_on="p_partkey") \
-           .merge(supp, left_on="lo_suppkey", right_on="s_suppkey") \
-           .merge(date, left_on="lo_orderdate", right_on="d_datekey")
     df = pd.DataFrame({
-        "ts": pd.to_datetime(lo["d_year"].astype(str)),
+        "ts": pd.to_datetime(lo["lo_orderdate_ts"]),
         "brand": lo["p_brand1"].astype(str),
         "region": lo["s_region"].astype(str),
         "dyear": lo["d_year"].astype(np.int64),
