@@ -458,15 +458,15 @@ class QueryRunner:
         ingest the pruned set is contiguous on the segment axis, so the
         dispatch dynamic-slices the [S, R] working set down to a pow2-
         quantized window and reads ONLY those bytes — this is what turns
-        SURVEY.md §3.5 P4 pruning into real HBM savings. Skipped when a
-        mesh shards the segment axis (per-shard windows would need
-        divisibility), for mask-kind plans (the scan assembler indexes
-        the full axis), for Pallas plans (the kernel's grid floors
-        n // rb at its own row-block size, so a window that is not a
-        multiple of rb would silently drop rows — fuzz seed 78), and
-        when the window saves <25%."""
-        if self.mesh is not None or plan.empty or plan.kind == "mask" \
-                or plan.pallas_reason is None:
+        SURVEY.md §3.5 P4 pruning into real HBM savings. Safe for the
+        Pallas kernel too: its grid is shape-driven and its row block
+        rb divides block_rows by eligibility (pallas_reduce.eligible),
+        so a window of W blocks is always an exact rb multiple >= rb.
+        Skipped when a mesh shards the segment axis (per-shard windows
+        would need divisibility), for mask-kind plans (the scan
+        assembler indexes the full axis), and when the window saves
+        <25%."""
+        if self.mesh is not None or plan.empty or plan.kind == "mask":
             return None
         ids = plan.pruned_ids
         if not ids:
