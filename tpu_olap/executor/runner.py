@@ -462,11 +462,12 @@ class QueryRunner:
         Pallas kernel too: its grid is shape-driven and its row block
         rb divides block_rows by eligibility (pallas_reduce.eligible),
         so a window of W blocks is always an exact rb multiple >= rb.
-        Skipped when a mesh shards the segment axis (per-shard windows
-        would need divisibility), for mask-kind plans (the scan
-        assembler indexes the full axis), and when the window saves
-        <25%."""
-        if self.mesh is not None or plan.empty or plan.kind == "mask":
+        Mask-kind plans window too: _run_partials re-embeds the
+        windowed mask into the full segment stack, so the scan/select/
+        search assemblers keep indexing by global segment id. Skipped
+        when a mesh shards the segment axis (per-shard windows would
+        need divisibility) and when the window saves <25%."""
+        if self.mesh is not None or plan.empty:
             return None
         ids = plan.pruned_ids
         if not ids:
@@ -507,6 +508,22 @@ class QueryRunner:
         if win is not None:
             metrics["segments_window"] = win[1]
 
+        n_seg_full = len(seg_mask)
+
+        def _embed_mask(out):
+            """Windowed mask back into the full segment stack: every
+            consumer (scan/select/search assembly) indexes rows by
+            GLOBAL segment id; segments outside the window are pruned,
+            so their rows are legitimately all-False."""
+            if win is None or plan.kind != "mask":
+                return out
+            lo, W = win
+            w = out["mask"].reshape(W, -1)
+            full = np.zeros((n_seg_full, w.shape[1]), bool)
+            full[lo:lo + W] = w
+            out["mask"] = full.reshape(-1)
+            return out
+
         if self.config.platform == "cpu":
             t0 = time.perf_counter()
             if win is not None:
@@ -517,7 +534,7 @@ class QueryRunner:
             metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
             metrics["cache_hit"] = False
             metrics["num_shards"] = 1
-            return {k: np.asarray(v) for k, v in out.items()}
+            return _embed_mask({k: np.asarray(v) for k, v in out.items()})
 
         import jax
         mesh = self.mesh
@@ -542,7 +559,7 @@ class QueryRunner:
         metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
         metrics["cache_hit"] = hit
         metrics["num_shards"] = mesh.devices.size if mesh else 1
-        return out
+        return _embed_mask(out)
 
     def _args_for(self, plan: PhysicalPlan, seg_mask: np.ndarray, mesh):
         """Device copies of the per-call inputs (const pool + segment
