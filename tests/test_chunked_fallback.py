@@ -324,11 +324,11 @@ def test_parallel_chunked_empty_result(tmp_path):
 
 
 def test_parallel_distinct_pair_cap_refuses(tmp_path):
-    """The pair cap must hold on the PARALLEL path too: a fork worker's
-    legible refusal (raised at its local compaction) propagates out of
-    the pool as the same FallbackError the sequential compact() raises —
-    never a silent sequential retry that grinds toward the cap twice,
-    and never an OOM."""
+    """The pair cap must hold on the PARALLEL path too: a fork worker
+    refuses at its local compaction (bounding worker memory), which
+    degrades to the sequential loop — and a genuinely over-cap query
+    then refuses legibly at the TRUE cap from the sequential compact(),
+    never an OOM and never a silent wrong answer."""
     paths = _write_dataset(str(tmp_path))
     par = Engine(EngineConfig(fallback_chunk_rows=100,
                               fallback_chunk_batch_rows=1024,
@@ -339,3 +339,30 @@ def test_parallel_distinct_pair_cap_refuses(tmp_path):
         "SELECT count(DISTINCT price) AS d FROM t").stmt
     with pytest.raises(FallbackError, match="fallback_scan_row_cap"):
         execute_fallback(stmt, par.catalog, par.config)
+
+
+def test_parallel_divided_cap_false_refusal_retries_sequentially(tmp_path):
+    """Fork workers cap their LOCAL distinct sets at pair_cap // workers
+    (so total in-flight pairs cannot transiently reach workers x
+    pair_cap) — but interleaved row groups mean each worker's distinct
+    set nearly duplicates the global universe, so a refusal at the
+    divided cap is ambiguous about the real cap. It must degrade to the
+    sequential loop (which enforces the configured cap exactly): a
+    query whose distinct count fits the REAL cap succeeds instead of
+    surfacing the worker's false refusal."""
+    paths = _write_dataset(str(tmp_path))
+    # price has ~999 distinct values: over 1500 // 4 = 375 per-worker,
+    # under the configured 1500
+    par = Engine(EngineConfig(fallback_chunk_rows=100,
+                              fallback_chunk_batch_rows=1024,
+                              fallback_parallel_workers=4,
+                              fallback_scan_row_cap=1500))
+    whole = Engine(EngineConfig(fallback_chunk_rows=10**9))
+    for e in (par, whole):
+        e.register_table("t", paths, time_column="ts")
+    sql = "SELECT count(DISTINCT price) AS d FROM t"
+    got = execute_fallback(par.planner.plan(sql).stmt, par.catalog,
+                           par.config)
+    want = execute_fallback(whole.planner.plan(sql).stmt, whole.catalog,
+                            whole.config)
+    assert int(got["d"].iloc[0]) == int(want["d"].iloc[0]) > 375

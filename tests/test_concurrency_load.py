@@ -93,3 +93,55 @@ def test_no_class_starves_under_mixed_load(served_engine):
     # the device lock serialized device dispatches without deadlock:
     # grouped+ungrouped rode the device path (history counts them)
     assert len(eng.history) >= len(by_class["grouped"])
+
+
+def test_coalescing_window_batches_concurrent_queries():
+    """batch_window_ms > 0: concurrent execute() callers ride ONE
+    shared-scan dispatch (executor.batch.Coalescer) — identical
+    in-flight queries scan once, distinct compatible ones fuse — and
+    every caller still gets exactly its own sequential-path result."""
+    rng = np.random.default_rng(23)
+    rows = 20_000
+    df = pd.DataFrame({
+        "ts": pd.to_datetime("2024-01-01")
+        + pd.to_timedelta(rng.integers(0, 86400 * 30, rows), unit="s"),
+        "g": rng.choice([f"g{i}" for i in range(16)], rows),
+        "v": rng.integers(0, 1000, rows).astype(np.int64),
+    })
+    eng = Engine(EngineConfig(batch_window_ms=40.0))
+    eng.register_table("t", df, time_column="ts", block_rows=1 << 12)
+    sqls = {
+        "a": "SELECT g, sum(v) AS s FROM t GROUP BY g ORDER BY g",
+        "b": "SELECT sum(v) AS s, count(*) AS n FROM t WHERE v < 500",
+    }
+    ref = {k: eng.sql(q) for k, q in sqls.items()}  # warm via coalescer
+    h0 = len(eng.history)
+
+    out: dict = {}
+    n_threads = 6
+    barrier = threading.Barrier(n_threads)
+
+    def client(i, key):
+        barrier.wait()
+        out[(i, key)] = eng.sql(sqls[key])
+
+    threads = [threading.Thread(target=client,
+                                args=(i, "a" if i % 2 else "b"))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert len(out) == n_threads
+    for (i, key), frame in out.items():
+        assert frame.equals(ref[key]), (i, key)
+    # at least one multi-query batch formed inside the window, and its
+    # shared pass carries the attribution fields
+    hist = eng.history[h0:]
+    batched = [m for m in hist if m.get("batch_size", 0) >= 2
+               and not m.get("batch_dedup")]
+    assert batched, "no coalesced batch formed inside the window"
+    assert all("scan_ms_shared" in m and "agg_ms" in m for m in batched)
+    # far fewer physical scans than logical queries
+    scans = [m for m in hist if not m.get("batch_dedup")]
+    assert len(scans) < n_threads

@@ -6,6 +6,7 @@ QUERY), and CLEAR DRUID CACHE.
 
 from __future__ import annotations
 
+import numpy as np
 import pandas as pd
 
 from tpu_olap.catalog import Catalog, StarSchema, TableEntry
@@ -37,9 +38,12 @@ class Engine:
         # are not concurrent and the chip has one program queue anyway,
         # SURVEY.md §3.5 P1). Planning and the pandas fallback run outside
         # it, so concurrent HTTP clients aren't wedged behind one slow
-        # device query (VERDICT round 1 "missing" #6).
-        import threading
-        self.device_lock = threading.RLock()
+        # device query (VERDICT round 1 "missing" #6). The lock now LIVES
+        # on the runner (QueryRunner.dispatch_lock) so the shared-scan
+        # coalescer can let concurrent callers wait outside it and ride
+        # one fused dispatch (executor.batch); this alias keeps the
+        # engine-level admin surface (clear_cache) on the same lock.
+        self.device_lock = self.runner.dispatch_lock
         # planner-initiated subquery execution (uncorrelated shapes
         # inline as literals so the outer query can push down; the inner
         # aggregate itself rides the device path when rewritable)
@@ -186,9 +190,11 @@ class Engine:
         if plan.rewritten:
             res = None
             try:
-                with self.device_lock:
-                    res = self.runner.execute(plan.query,
-                                              plan.entry.segments)
+                # the runner serializes dispatch internally
+                # (dispatch_lock) — and with batch_window_ms set,
+                # concurrent callers coalesce into one fused dispatch
+                res = self.runner.execute(plan.query,
+                                          plan.entry.segments)
             except _UNSUPPORTED as e:
                 plan.query = None
                 plan.fallback_reason = f"lowering failed: {e}"
@@ -246,7 +252,11 @@ class Engine:
             leg_plans.append(lp)
             f = self._execute_plan(lp)
             for name, val in consts.items():
-                f[name] = val  # None -> object column of NULLs
+                # absent group keys reattach as np.nan (float64 NULL),
+                # matching the whole-statement fallback's dtype — a bare
+                # None would make an object column that breaks numeric
+                # comparisons/sorts over the union
+                f[name] = np.nan if val is None else val
             frames.append(f.loc[:, out_names])
         plan.grouping_legs = leg_plans
         n_dev = sum(1 for lp in leg_plans if lp.rewritten)
@@ -261,6 +271,57 @@ class Engine:
         lo = stmt.offset
         hi = None if stmt.limit is None else lo + stmt.limit
         return out.iloc[lo:hi].reset_index(drop=True)
+
+    def sql_batch(self, queries) -> list[pd.DataFrame]:
+        """Execute several SQL statements as one submission, fusing
+        rewritten device queries against the same table into shared-scan
+        batch dispatches (executor.batch): identical statements scan
+        once, compatible aggregations ride one fused device pass.
+        Statement verbs and fallback statements run individually; any
+        leg that fails on the batch path re-runs through the ordinary
+        single-query path (device retry, then pandas fallback), so the
+        'never an error' property holds per statement. Results come
+        back in input order."""
+        queries = list(queries)
+        outs: list = [None] * len(queries)
+        plans: dict[int, object] = {}
+        groups: dict[str, list[int]] = {}
+        for i, q in enumerate(queries):
+            verb = _match_verb(q)
+            if verb is not None:
+                outs[i] = verb(self)
+                continue
+            plan = self.planner.plan(q)
+            plans[i] = plan
+            stmt = getattr(plan, "stmt", None)
+            if plan.rewritten and not (
+                    stmt is not None
+                    and getattr(stmt, "grouping_sets", None) is not None):
+                groups.setdefault(plan.entry.name, []).append(i)
+        done = set()
+        for name, idxs in groups.items():
+            if len(idxs) < 2:
+                continue
+            entry = self.catalog.get(name)
+            boxed = self.runner._execute_batch_boxed(
+                [plans[i].query for i in idxs], entry.segments)
+            for i, b in zip(idxs, boxed):
+                if isinstance(b, BaseException):
+                    if not isinstance(b, Exception):
+                        # KeyboardInterrupt/SystemExit: abort the whole
+                        # submission — retrying would turn a cancel into
+                        # double work
+                        raise b
+                    continue  # single-query path (retry+fallback) below
+                outs[i] = self._frame_from(plans[i], b)
+                done.add(i)
+        for i, plan in plans.items():
+            if i in done:
+                continue
+            outs[i] = self._execute_plan(plan)
+        if plans:
+            self.last_plan = plans[max(plans)]
+        return outs
 
     def _run_stmt(self, stmt) -> pd.DataFrame:
         """Execute one parsed statement end-to-end (device path when
@@ -313,8 +374,9 @@ class Engine:
         if not entry.is_accelerated:
             raise ValueError(
                 f"table {query.data_source!r} is not accelerated")
-        with self.device_lock:
-            return self.runner.execute(query, entry.segments)
+        # the runner locks (or coalesces) internally; holding the lock
+        # here would deadlock a coalesced submission against its leader
+        return self.runner.execute(query, entry.segments)
 
     def select_page(self, table: str, columns=None, page_size: int = 100,
                     offset: int = 0, descending: bool = False,

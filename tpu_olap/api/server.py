@@ -144,6 +144,14 @@ class QueryServer:
             frame = self.engine.sql(req["query"])
             return {"columns": list(frame.columns),
                     "rows": frame.to_dict("records")}
+        if path == "/sql/batch":
+            # explicit batch submission: one POST, N statements, shared
+            # scans where compatible (Engine.sql_batch / executor.batch)
+            req = json.loads(body)
+            frames = self.engine.sql_batch(req["queries"])
+            return {"results": [{"columns": list(f.columns),
+                                 "rows": f.to_dict("records")}
+                                for f in frames]}
         if path in ("/druid/v2", "/druid/v2/"):
             spec = json.loads(body)
             res = self.engine.execute_ir(spec)

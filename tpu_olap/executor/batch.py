@@ -1,0 +1,549 @@
+"""Shared-scan batch executor: fuse N compatible queries into ONE pass.
+
+PROFILE_CPU.json shows a ~65 ms execute floor per query even when the
+result is a single group — every query re-scans the full segment stream,
+so N concurrent SSB queries cost N full scans. This module kills that
+floor the way the reference's Druid broker did (many rewritten Spark
+queries answered from one shared column store, SURVEY.md §3.1): queries
+against the same table that lower to dense aggregation plans are fused
+into one device pass in which each segment window is read once and feeds
+N per-query (filter-mask, agg-plan) legs, each reusing the single-query
+compile_aggregations/group_reduce machinery (kernels.groupby.
+group_reduce_batch) and emitting its own independent partials dict.
+
+Three entry points:
+
+- run_batch(runner, queries, table): the boxed batch executor — dedupe
+  identical queries (one physical scan serves every copy), fuse
+  compatible dense-agg legs into one jitted program (or the chunked
+  numpy shared scan on the "cpu" platform), run everything else through
+  the ordinary single-query path. Per-leg failures are boxed, never
+  collective.
+- Coalescer: the micro-batching window. Concurrent QueryRunner.execute()
+  callers enqueue; the first arrival leads, sleeps batch_window_ms, and
+  dispatches everyone who arrived in the window as one batch
+  (EngineConfig.batch_window_ms, off by default).
+- fusable(plan, mesh): the compatibility rule, shared with tests.
+
+Metrics: every leg of a fused dispatch records `batch_id` (count the
+shared pass ONCE per id), `batch_size` (logical queries served),
+`scan_ms_shared` (wall of the one shared pass) and `agg_ms` (this leg's
+share of it — measured per leg on the numpy platform, attributed by
+scanned-work weight on the jit platform, where the inside of one XLA
+program cannot be timed per leg). See docs/BATCH_EXECUTION.md.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import numpy as np
+
+from tpu_olap.executor.runner import QueryResult, _next_pow2
+from tpu_olap.ir.query import (GroupByQuerySpec, TimeseriesQuerySpec,
+                               TopNQuerySpec)
+from tpu_olap.kernels.groupby import group_reduce_batch, merge_partials
+
+AGG_QUERY_TYPES = (TimeseriesQuerySpec, GroupByQuerySpec, TopNQuerySpec)
+
+
+def fusable(plan, mesh) -> str | None:
+    """None when the plan can ride a fused shared-scan dispatch, else the
+    reason it must run alone (through the single-query path)."""
+    if plan.kind != "agg":
+        return "only aggregation plans fuse"
+    if plan.sparse:
+        return "sparse group-by legs run alone"
+    if plan.key_fn is None:
+        return "plan has no batchable key_fn"
+    if mesh is not None:
+        return "mesh sharding not supported on the batch path"
+    return None
+
+
+def run_batch(runner, queries, table) -> list:
+    """Execute N queries against one table, sharing scans where possible.
+
+    Returns a boxed list in input order: QueryResult per success,
+    the exception per failed leg (the caller — Coalescer.submit or
+    Engine.sql_batch — re-raises or falls back PER QUERY, preserving the
+    'never an error' property query-by-query)."""
+    queries = list(queries)
+    boxed: list = [None] * len(queries)
+
+    # dedupe identical queries first: one physical pass serves every
+    # copy (the BI dashboard-storm case — 8 users on the same panel)
+    uniq: dict[str, list[int]] = {}
+    for i, q in enumerate(queries):
+        key = json.dumps(q.to_json(), sort_keys=True, default=str)
+        uniq.setdefault(key, []).append(i)
+
+    singles, fused = [], []   # [(query, duplicate indexes, plan)]
+    for idxs in uniq.values():
+        q = queries[idxs[0]]
+        try:
+            plan = runner._lower_cached(q, table)
+            reason = fusable(plan, runner.mesh) \
+                if isinstance(q, AGG_QUERY_TYPES) else "non-agg query type"
+        except Exception as e:  # noqa: BLE001 — boxed per leg
+            for i in idxs:
+                boxed[i] = e
+            continue
+        (fused if reason is None else singles).append((q, idxs, plan))
+
+    # window compatibility (the ISSUE's "same segment window" rule):
+    # every leg of a fused pass computes over the UNION window, so legs
+    # with disjoint pruned windows would multiply per-leg scan work
+    # instead of amortizing it — fuse only overlap clusters
+    clusters, alone = _window_clusters(fused)
+    singles.extend(alone)
+    fused_groups = []
+    for cl in clusters:
+        if len(cl) == 1:
+            # a lone fusable leg gains nothing from the fused program:
+            # run it on the richer single-query path (packed fetch,
+            # per-plan window) — the dedupe above is still a shared
+            # scan when it serves several copies
+            singles.append(cl[0])
+        else:
+            fused_groups.append(cl)
+
+    for q, idxs, plan in singles:
+        try:
+            # _execute_locked, not _execute: the single-leg path keeps
+            # the deadline watchdog + wedged-device reprobe of a plain
+            # execute() call (run_batch's caller holds dispatch_lock)
+            res = runner._execute_locked(q, table)
+        except BaseException as e:  # noqa: BLE001 — boxed per leg
+            for i in idxs:
+                boxed[i] = e
+            continue
+        if len(idxs) > 1:
+            m = res.metrics
+            m["batch_id"] = runner._next_batch_id()
+            m["batch_size"] = len(idxs)
+            m["batch_legs"] = 1
+            m["scan_ms_shared"] = m.get("execute_ms", 0.0)
+            m["agg_ms"] = m.get("execute_ms", 0.0)
+        _fan_out(runner, boxed, res, idxs, queries)
+
+    maxq = max(2, int(runner.config.batch_max_queries))
+    for cl in fused_groups:
+        # canonical leg order => one fused program per batch COMPOSITION
+        # (the jit cache is keyed on the ordered fingerprint tuple)
+        cl.sort(key=lambda t: repr(t[2].fingerprint()))
+        for k in range(0, len(cl), maxq):
+            group = cl[k:k + maxq]
+            try:
+                if len(group) == 1:  # a max-size split remainder
+                    q, idxs, plan = group[0]
+                    results = [runner._execute_locked(q, table)]
+                else:
+                    results = _run_fused(runner, table, group)
+            except BaseException as e:  # noqa: BLE001 — boxed per leg
+                for _, idxs, _ in group:
+                    for i in idxs:
+                        boxed[i] = e
+                continue
+            for (q, idxs, _), res in zip(group, results):
+                _fan_out(runner, boxed, res, idxs, queries)
+    return boxed
+
+
+def _window_clusters(fused):
+    """Partition fusable legs into overlap clusters: a leg joins a
+    cluster only while one union-window pass over the cluster costs no
+    more than ~1.3x the legs' individual windowed passes (each fused
+    leg computes over the whole union window — pruned-away segments
+    multiply by zero but still cost compute). Legs with no pruned
+    segments (empty intervals) come back in the second list and take
+    the single-query path. Greedy over span-sorted legs, so clustering
+    is deterministic and repeated workloads hit the same fused-program
+    compositions in the jit cache."""
+    spans, alone = [], []
+    for item in fused:
+        plan = item[2]
+        ids = plan.pruned_ids if not plan.empty else []
+        if not ids:
+            alone.append(item)
+            continue
+        spans.append((min(ids), max(ids) + 1, item))
+    spans.sort(key=lambda s: (s[0], s[1]))
+    clusters = []
+    cur, cur_lo, cur_hi, cur_sum = [], 0, 0, 0
+    for lo, hi, item in spans:
+        if cur:
+            u_lo, u_hi = min(cur_lo, lo), max(cur_hi, hi)
+            if (len(cur) + 1) * (u_hi - u_lo) \
+                    <= 1.3 * (cur_sum + hi - lo):
+                cur.append(item)
+                cur_lo, cur_hi = u_lo, u_hi
+                cur_sum += hi - lo
+                continue
+            clusters.append(cur)
+        cur, cur_lo, cur_hi, cur_sum = [item], lo, hi, hi - lo
+    if cur:
+        clusters.append(cur)
+    return clusters, alone
+
+
+def _fan_out(runner, boxed, res, idxs, queries):
+    """First duplicate gets the computed result; the rest share its rows
+    (the scan ran once) under their own QueryResult + history record."""
+    boxed[idxs[0]] = res
+    for i in idxs[1:]:
+        dup = QueryResult(queries[i], res.rows, res.druid,
+                          {**res.metrics, "batch_dedup": True})
+        runner.history.append(dup.metrics)
+        boxed[i] = dup
+
+
+# ------------------------------------------------------------- fused pass
+
+
+def _run_fused(runner, table, group):
+    """group: >= 2 unique dense-agg legs against one table. Build the
+    union env ONCE, run ONE fused pass, finalize/assemble per leg."""
+    from tpu_olap.executor.results import (agg_specs_by_name, eval_post_aggs,
+                                           finalize_aggs, theta_raw_fields)
+
+    t_start = time.perf_counter()
+    plans = [p for _, _, p in group]
+    n_logical = sum(len(idxs) for _, idxs, _ in group)
+    batch_id = runner._next_batch_id()
+    metrics_list = [{"query_type": q.query_type, "datasource": table.name,
+                     "batch_id": batch_id, "batch_size": n_logical,
+                     "batch_legs": len(group)} for q, _, _ in group]
+
+    def dispatch():
+        # env build lives INSIDE the retried callable: a _dispatch retry
+        # purges the table's device state, so the rebuilt attempt must
+        # re-prepare (stale buffers could be poisoned by a device reset)
+        leg_envs, seg_masks = [], []
+        valid = None
+        for plan, m in zip(plans, metrics_list):
+            env, valid, seg_mask = runner._prepare(plan, m)
+            leg_envs.append(env)
+            seg_masks.append(seg_mask)
+        win = _union_window(plans, len(seg_masks[0]))
+        if win is not None:
+            for m in metrics_list:
+                m["segments_window"] = win[1]
+        if runner.config.platform == "cpu":
+            return _run_fused_numpy(runner, plans, leg_envs, valid,
+                                    seg_masks, win) + (False,)
+        return _run_fused_device(runner, table, plans, leg_envs, valid,
+                                 seg_masks, win)
+
+    # retry-based recovery identical to the single-query path (the
+    # shared metrics of leg 0 carry any retry_errors), under the same
+    # deadline/wedge guard — a wedged device must not hang every
+    # coalesced caller past query_deadline_s
+    partials_list, shared_ms, agg_ms, hit = runner._guarded_dispatch(
+        dispatch, metrics_list[0], table.name)
+
+    results = []
+    for (q, idxs, plan), m, partials, leg_ms in zip(
+            group, metrics_list, partials_list, agg_ms):
+        t0 = time.perf_counter()
+        specs = agg_specs_by_name(q.aggregations)
+        keep_raw = theta_raw_fields(q.post_aggregations)
+        arrays = finalize_aggs(partials, plan.agg_plans, specs, keep_raw)
+        eval_post_aggs(arrays, q.post_aggregations)
+        res = runner._assemble_agg(q, plan, arrays)
+        m["scan_ms_shared"] = shared_ms
+        m["agg_ms"] = leg_ms
+        m["cache_hit"] = hit
+        m["num_shards"] = 1
+        m["assemble_ms"] = (time.perf_counter() - t0) * 1000
+        m["total_ms"] = (time.perf_counter() - t_start) * 1000
+        res.metrics = m
+        runner.history.append(m)
+        results.append(res)
+    return results
+
+
+def _union_window(plans, n_segments):
+    """(lo, W) covering every leg's pruned segments, or None — the batch
+    analog of QueryRunner._segment_window. Legs whose own pruned set is
+    smaller still read only the union window; their per-leg seg_mask
+    zeroes the rest (adding exact zeros, so per-query results stay
+    bitwise identical to the single-query windowed pass)."""
+    ids = sorted({i for p in plans if not p.empty for i in p.pruned_ids})
+    if not ids:
+        return None
+    lo, hi = ids[0], ids[-1] + 1
+    W = _next_pow2(hi - lo)
+    if 4 * W >= 3 * n_segments:
+        return None
+    return min(lo, n_segments - W), W
+
+
+def _buffer_layout(leg_envs):
+    """Unique env arrays -> one flat buffer list + per-leg {name: index}
+    specs. Buffers shared across legs (same ds column) appear ONCE —
+    that is the 'read each column once' half of the shared scan. The
+    layout is deterministic given the legs' column sets, so a cached
+    fused program (keyed on the ordered fingerprint tuple) always sees
+    buffers in the order its closure captured."""
+    buffers, index, layouts = [], {}, []
+    for env in leg_envs:
+        spec = {"cols": {}, "nulls": {}}
+        for kind in ("cols", "nulls"):
+            for name, arr in env[kind].items():
+                j = index.get(id(arr))
+                if j is None:
+                    j = index[id(arr)] = len(buffers)
+                    buffers.append(arr)
+                spec[kind][name] = j
+        layouts.append(spec)
+    return buffers, layouts
+
+
+def _layout_key(layouts):
+    """Hashable form of per-leg buffer layouts for the jit-cache key."""
+    return tuple((tuple(sorted(s["cols"].items())),
+                  tuple(sorted(s["nulls"].items()))) for s in layouts)
+
+
+def _build_fused(plans, layouts):
+    """The fused kernel: every leg's (filter, dims, key) front half runs
+    over the shared buffers, then kernels.groupby.group_reduce_batch
+    emits N independent partials dicts — all traced into one program."""
+    def fused(buffers, valid, seg_masks, consts_list):
+        legs = []
+        for plan, spec, sm, consts in zip(plans, layouts, seg_masks,
+                                          consts_list):
+            env = {"cols": {n: buffers[j]
+                            for n, j in spec["cols"].items()},
+                   "nulls": {n: buffers[j]
+                             for n, j in spec["nulls"].items()}}
+            fenv, mask, key = plan.key_fn(env, valid, sm, consts)
+            legs.append((key, mask, fenv, plan.agg_plans,
+                         plan.total_groups))
+        return group_reduce_batch(legs, consts_list)
+    return fused
+
+
+def _window_fused(fused, W: int):
+    """Dynamic-slice every [S, ...] input to the union window before the
+    fused compute (one compile per (composition, W); `lo` is traced)."""
+    import jax
+
+    def fn(buffers, valid, seg_masks, consts_list, lo):
+        def sl(a):
+            return jax.lax.dynamic_slice_in_dim(a, lo, W, axis=0)
+        return fused([sl(b) for b in buffers], sl(valid),
+                     [sl(m) for m in seg_masks], consts_list)
+    return fn
+
+
+def _run_fused_device(runner, table, plans, leg_envs, valid, seg_masks,
+                      win):
+    """One jitted fused program per batch composition. Returns
+    (partials per leg, shared wall ms, attributed per-leg ms, cache hit).
+    """
+    import jax
+
+    buffers, layouts = _buffer_layout(leg_envs)
+    # the layout is part of the key: a cached program's closure bakes in
+    # its compile-time {name: buffer-index} maps, and the SHARING
+    # structure can legitimately change between dispatches (an HBM-ledger
+    # eviction between two legs' _prepare calls refetches a column as a
+    # distinct object) — reusing the old closure over a differently-
+    # shaped buffer list would read the wrong column
+    key = (table.name, "batch",
+           tuple(p.fingerprint() for p in plans),
+           win[1] if win else 0,
+           _layout_key(layouts))
+    jitted = runner._jit_cache.get(key)
+    hit = jitted is not None
+    if not hit:
+        fused = _build_fused(plans, layouts)
+        if win is not None:
+            fused = _window_fused(fused, win[1])
+        jitted = jax.jit(fused)
+        runner._jit_cache[key] = jitted
+    consts_list, seg_args = [], []
+    for plan, sm in zip(plans, seg_masks):
+        cdev, sarg = runner._args_for(plan, sm, None)
+        consts_list.append(cdev)
+        seg_args.append(sarg)
+    t0 = time.perf_counter()
+    outs = jitted(buffers, valid, seg_args, consts_list, win[0]) \
+        if win is not None else jitted(buffers, valid, seg_args,
+                                       consts_list)
+    outs = [{k: np.asarray(v) for k, v in o.items()} for o in outs]
+    shared_ms = (time.perf_counter() - t0) * 1000
+    # per-leg attribution: one XLA program cannot be timed from outside
+    # per leg; split the shared wall by each leg's scanned-work weight
+    # (columns read x segments scanned x agg plans) — an estimate,
+    # labeled as such in docs/BATCH_EXECUTION.md
+    w = [max(1, (len(p.columns) + 1) * max(1, len(p.pruned_ids))
+             * (len(p.agg_plans) + 1)) for p in plans]
+    tw = float(sum(w))
+    agg_ms = [shared_ms * wi / tw for wi in w]
+    return outs, shared_ms, agg_ms, hit
+
+
+def _run_fused_numpy(runner, plans, leg_envs, valid, seg_masks, win):
+    """Chunked shared scan on the numpy platform: the union segment
+    window is sliced chunk by chunk, and every leg's kernel runs over
+    the chunk while it is cache-hot — each chunk's bytes stream from
+    DRAM once for all N legs instead of once per query. Chunks fan out
+    over a small thread pool (numpy releases the GIL on large array
+    ops). Per-leg partials merge in chunk order via merge_partials;
+    note chunked float sums can differ from the single-pass path in the
+    last ulp (addition reorders across chunk boundaries)."""
+    valid = np.asarray(valid)
+    n_seg = len(seg_masks[0])
+    lo, hi = (win[0], win[0] + win[1]) if win is not None else (0, n_seg)
+    C = max(1, int(runner.config.batch_chunk_segments))
+    bounds = [(a, min(a + C, hi)) for a in range(lo, hi, C)]
+    t_all = time.perf_counter()
+    agg_ms = [0.0] * len(plans)
+    mu = threading.Lock()
+
+    def slice_env(env, sl):
+        return {"cols": {n: v[sl] for n, v in env["cols"].items()},
+                "nulls": {n: v[sl] for n, v in env["nulls"].items()}}
+
+    def one_chunk(b):
+        a, z = b
+        sl = slice(a, z)
+        outs = []
+        for i, plan in enumerate(plans):
+            sm = seg_masks[i][sl]
+            if not sm.any():
+                outs.append(None)
+                continue
+            t0 = time.perf_counter()
+            out = plan.kernel(slice_env(leg_envs[i], sl), valid[sl], sm,
+                              plan.pool.consts)
+            dt = (time.perf_counter() - t0) * 1000
+            with mu:
+                agg_ms[i] += dt
+            outs.append({k: np.asarray(v) for k, v in out.items()})
+        return outs
+
+    threads = int(runner.config.batch_cpu_threads)
+    if threads == 0:
+        import os
+        threads = min(4, os.cpu_count() or 1)
+    if threads > 1 and len(bounds) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=threads) as ex:
+            chunk_outs = list(ex.map(one_chunk, bounds))
+    else:
+        chunk_outs = [one_chunk(b) for b in bounds]
+
+    partials_list = []
+    for i, plan in enumerate(plans):
+        acc = None
+        for outs in chunk_outs:
+            o = outs[i]
+            if o is None:
+                continue
+            acc = o if acc is None else merge_partials(acc, o,
+                                                       plan.agg_plans)
+        if acc is None:
+            # fully pruned/empty leg: one all-masked evaluation over a
+            # single segment yields the correctly-shaped zero partials
+            a = min(lo, max(0, n_seg - 1))
+            z = min(a + 1, n_seg)
+            sl = slice(a, z)
+            acc = plan.kernel(slice_env(leg_envs[i], sl), valid[sl],
+                              np.zeros(z - a, bool), plan.pool.consts)
+            acc = {k: np.asarray(v) for k, v in acc.items()}
+        partials_list.append(acc)
+    shared_ms = (time.perf_counter() - t_all) * 1000
+    # with chunks fanned over threads, per-leg CPU times sum past the
+    # shared wall; rescale so sum(agg_ms) <= scan_ms_shared holds (the
+    # documented attribution invariant) while keeping relative weights
+    total = sum(agg_ms)
+    if total > shared_ms > 0:
+        agg_ms = [a * shared_ms / total for a in agg_ms]
+    return partials_list, shared_ms, agg_ms
+
+
+# -------------------------------------------------------------- coalescer
+
+
+class _Pending:
+    __slots__ = ("query", "table", "event", "result", "error")
+
+    def __init__(self, query, table):
+        self.query = query
+        self.table = table
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+
+
+class Coalescer:
+    """Micro-batching window: the first concurrent caller leads, waits
+    batch_window_ms for companions, and dispatches everyone who arrived
+    as ONE run_batch call under the runner's dispatch lock. Followers
+    block on an event; per-query failures propagate to their own caller
+    only. A caller arriving after a leader has cut its batch becomes the
+    next leader, so windows pipeline under sustained load."""
+
+    def __init__(self, runner, window_s: float):
+        self.runner = runner
+        self.window_s = window_s
+        self._mu = threading.Lock()
+        self._queue: list = []
+        self._collecting = False
+
+    def submit(self, query, table):
+        me = _Pending(query, table)
+        with self._mu:
+            self._queue.append(me)
+            lead = not self._collecting
+            if lead:
+                self._collecting = True
+        if not lead:
+            me.event.wait()
+            if me.error is not None:
+                raise me.error
+            return me.result
+        # everything from here runs under try/finally: an async
+        # exception in the leader (KeyboardInterrupt mid-sleep) must
+        # still reset _collecting, drain the queue, and wake every
+        # follower — else the coalescer is wedged for the process life
+        batch: list = []
+        try:
+            try:
+                if self.window_s > 0:
+                    time.sleep(self.window_s)
+            finally:
+                with self._mu:
+                    batch, self._queue = self._queue, []
+                    self._collecting = False
+            by_table: dict = {}
+            for it in batch:
+                by_table.setdefault(id(it.table), []).append(it)
+            for items in by_table.values():
+                try:
+                    with self.runner.dispatch_lock:
+                        boxed = run_batch(self.runner,
+                                          [it.query for it in items],
+                                          items[0].table)
+                except BaseException as e:  # noqa: BLE001 — fan out
+                    boxed = [e] * len(items)
+                for it, b in zip(items, boxed):
+                    if isinstance(b, BaseException):
+                        it.error = b
+                    else:
+                        it.result = b
+        finally:
+            for it in batch:
+                if it.result is None and it.error is None:
+                    it.error = RuntimeError(
+                        "batch leader exited without a result")
+                it.event.set()
+        if me.error is not None:
+            raise me.error
+        return me.result

@@ -95,14 +95,40 @@ class EngineConfig:
     # deadlocked fork worker can stall a query before the sequential
     # loop takes over (fork from a JAX-threaded parent can in principle
     # inherit a held allocator lock).
+    # Interactive default (ADVICE round 5: a deadlocked fork pool used to
+    # stall a query 15 min before the safe sequential retry; 45 s covers
+    # the legitimate parallel case at bench scales). The dispatcher
+    # additionally scales this UP with the estimated scan size
+    # (fallback._parallel_timeout_s) so huge tables are not cut off.
     fallback_parallel_workers: int = 0
-    fallback_parallel_timeout_s: float = 900.0
+    fallback_parallel_timeout_s: float = 45.0
     # FROM/JOIN (SELECT ...) bodies route back through the engine's
     # statement executor (device path when rewritable). False keeps the
     # interpreter pure — bench.parity.pure_config() derives that oracle
     # config, and run_both uses it so the fallback side of every parity
     # check stays an independent pandas execution.
     fallback_derived_on_device: bool = True
+
+    # shared-scan batch execution (executor.batch): compatible concurrent
+    # agg queries against one table fuse into ONE device pass — each
+    # segment window is read once and feeds N per-query (filter, agg)
+    # legs, killing the per-query scan floor (PROFILE_CPU.json: ~65 ms
+    # execute per query even for total_groups=1). batch_window_ms > 0
+    # turns on the request coalescer: concurrent QueryRunner.execute()
+    # callers wait up to this window and ride one fused dispatch
+    # (docs/BATCH_EXECUTION.md). 0 = off (single-query behavior,
+    # execute_batch() still available explicitly).
+    batch_window_ms: float = 0.0
+    # max logical queries per fused dispatch; larger batches split
+    batch_max_queries: int = 16
+    # numpy-platform ("cpu") shared scan: segments per chunk of the
+    # chunked batch loop — each chunk is sliced once and fed to every
+    # leg while cache-hot. Chunked float sums can differ from the
+    # single-pass path in the last ulp (merge reorders addition).
+    batch_chunk_segments: int = 64
+    # numpy-platform batch parallelism across chunks (numpy releases the
+    # GIL on large array ops): 0 = auto (min(4, cores)), 1 = serial
+    batch_cpu_threads: int = 0
 
     # execution platform: "device" = default jax backend, "cpu" = numpy path
     platform: str = "device"

@@ -58,6 +58,11 @@ class PhysicalPlan:
     pallas_reason: str | None = "not attempted"  # None = pallas kernel active
     sparse: bool = False       # sort-based path for huge group spaces
     make_sparse_kernel: object = None   # cap -> kernel fn (sparse only)
+    # fn(env, valid, seg_mask, consts) -> (fenv, mask, key): the plan's
+    # filter+dim front half WITHOUT the reduce, so the batch executor
+    # can fuse N legs' reduces over one shared scan (dense agg only;
+    # always the generic jnp/numpy path even when plan.kernel is Pallas)
+    key_fn: object = None
 
     def fingerprint(self) -> tuple:
         # memoized: plans are immutable once lowered and (round 3) cached
@@ -517,6 +522,11 @@ def _lower_agg(query, table, config) -> PhysicalPlan:
                                       build_group_key)
         return group_reduce(key, mask, fenv, agg_plans, total, consts)
 
+    def key_fn(env, valid, seg_mask, consts):
+        xp = np if isinstance(valid, np.ndarray) else _jnp()
+        return _masked_key(env, valid, seg_mask, consts, xp,
+                           build_group_key)
+
     def make_sparse_kernel(cap):
         from tpu_olap.kernels.sparse_groupby import (build_group_key64,
                                                      sparse_group_reduce)
@@ -544,7 +554,7 @@ def _lower_agg(query, table, config) -> PhysicalPlan:
         columns=columns, null_cols=null_cols, virtual_exprs=vexprs,
         filter_streams=_dedupe_streams(pool),
         sparse=sparse, make_sparse_kernel=make_sparse_kernel if sparse
-        else None)
+        else None, key_fn=None if sparse else key_fn)
     if not sparse:
         _maybe_use_pallas(plan, query, table, config, filter_fn, imask_fn)
     return plan

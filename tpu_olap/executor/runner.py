@@ -66,12 +66,22 @@ def _evict_one(cache: dict) -> None:
 
 class QueryRunner:
     def __init__(self, config: EngineConfig | None = None):
+        import threading
         self.config = config or EngineConfig()
         self.config.apply_x64()
         if self.config.platform == "cpu" and (self.config.num_shards or 1) > 1:
             raise ValueError(
                 "num_shards > 1 requires the jax device platform; the "
                 "numpy path ('cpu') is single-shard by construction")
+        # Serializes device dispatch (the chip has one program queue,
+        # SURVEY.md §3.5 P1). Engine.device_lock aliases this object so
+        # engine-level admin ops and runner-level dispatch share one
+        # lock; coalesced callers wait OUTSIDE it (executor.batch).
+        self.dispatch_lock = threading.RLock()
+        self._coalescer = None
+        self._batch_seq = 0
+        if (self.config.batch_window_ms or 0) > 0:
+            self.set_batch_window(self.config.batch_window_ms)
         self._datasets: dict = {}
         from tpu_olap.executor.dataset import HbmLedger
         self._hbm_ledger = HbmLedger(self.config.hbm_budget_bytes)
@@ -130,7 +140,68 @@ class QueryRunner:
 
     # ------------------------------------------------------------------ API
 
+    def set_batch_window(self, window_ms: float | None):
+        """Enable/disable the shared-scan request coalescer at runtime
+        (EngineConfig.batch_window_ms sets it at construction; the
+        concurrency bench A/B toggles it). With a window, concurrent
+        execute() callers of agg queries ride one fused dispatch
+        (executor.batch.Coalescer); 0/None restores per-call dispatch."""
+        from tpu_olap.executor.batch import Coalescer
+        self.config.batch_window_ms = float(window_ms or 0.0)
+        self._coalescer = Coalescer(self, float(window_ms) / 1000.0) \
+            if window_ms else None
+
+    def execute_batch(self, queries, table) -> list:
+        """Execute N queries against one table as a shared-scan batch
+        (executor.batch.run_batch): identical queries scan once,
+        compatible dense-agg legs fuse into one device pass, everything
+        else runs through the single-query path. Results come back in
+        input order; the first failed leg's exception raises (callers
+        that need per-leg failure isolation use _execute_batch_boxed)."""
+        boxed = self._execute_batch_boxed(list(queries), table)
+        for b in boxed:
+            if isinstance(b, BaseException):
+                raise b
+        return boxed
+
+    def _execute_batch_boxed(self, queries, table) -> list:
+        from tpu_olap.executor.batch import run_batch
+        with self.dispatch_lock:
+            return run_batch(self, queries, table)
+
+    def _next_batch_id(self) -> int:
+        self._batch_seq += 1
+        return self._batch_seq
+
+    def _guarded_dispatch(self, call, metrics: dict, table_name: str):
+        """_dispatch under the same deadline/wedge guard as the
+        single-query path: with query_deadline_s set, the fused batch
+        dispatch runs on a fresh daemon thread and is abandoned on
+        expiry (QueryDeadlineExceeded -> every leg's caller falls back),
+        and a wedged device is reprobed before being trusted again. The
+        batch executor's fused pass uses this so coalesced callers are
+        never hung past the deadline the single-query path honors."""
+        deadline = self.config.query_deadline_s
+        if deadline is None:
+            return self._dispatch(call, metrics, table_name)
+        if self._wedged:
+            self._reprobe_device(deadline)
+        return self._join_abandoning(
+            lambda: self._dispatch(call, metrics, table_name), deadline,
+            {"datasource": table_name, "batch_dispatch": True},
+            name="tpu-olap-batch-dispatch")
+
     def execute(self, query, table) -> QueryResult:
+        if self._coalescer is not None:
+            from tpu_olap.executor.batch import AGG_QUERY_TYPES
+            if isinstance(query, AGG_QUERY_TYPES):
+                # waits OUTSIDE dispatch_lock so concurrent callers can
+                # coalesce; the batch leader takes the lock to dispatch
+                return self._coalescer.submit(query, table)
+        with self.dispatch_lock:
+            return self._execute_locked(query, table)
+
+    def _execute_locked(self, query, table) -> QueryResult:
         deadline = self.config.query_deadline_s
         if deadline is not None:
             if self._wedged:
@@ -157,28 +228,39 @@ class QueryRunner:
         where a killed Spark task's Druid query keeps running server-side
         while the retry proceeds."""
         import threading
-        box: dict = {}
         abandoned = threading.Event()
+        return self._join_abandoning(
+            lambda: self._execute(query, table, abandoned), deadline,
+            {"query_type": query.query_type, "datasource": table.name},
+            on_timeout=abandoned.set)  # its history record is discarded
 
-        def work():
+    def _join_abandoning(self, work, deadline: float, record: dict,
+                         on_timeout=None, name="tpu-olap-dispatch"):
+        """Run `work` on a fresh daemon thread, abandoning it on expiry:
+        mark the device wedged, append `record` (stamped with the
+        deadline) to history, and raise QueryDeadlineExceeded. The one
+        deadline/wedge join shared by the single-query path
+        (_run_with_deadline) and the fused batch path
+        (_guarded_dispatch); `on_timeout` runs before the wedge is set
+        (e.g. flagging the abandoned thread to discard its record)."""
+        import threading
+        box: dict = {}
+
+        def run():
             try:
-                box["res"] = self._execute(query, table, abandoned)
+                box["res"] = work()
             except BaseException as e:  # noqa: BLE001 - relayed to caller
                 box["err"] = e
 
-        t = threading.Thread(target=work, daemon=True,
-                             name="tpu-olap-dispatch")
+        t = threading.Thread(target=run, daemon=True, name=name)
         t.start()
         t.join(deadline)
         if t.is_alive():
-            abandoned.set()  # its history record is discarded
+            if on_timeout is not None:
+                on_timeout()
             self._wedged = True
-            self.history.append({
-                "query_type": query.query_type,
-                "datasource": table.name,
-                "deadline_exceeded": True,
-                "total_ms": deadline * 1000,
-            })
+            self.history.append({**record, "deadline_exceeded": True,
+                                 "total_ms": deadline * 1000})
             raise QueryDeadlineExceeded(
                 f"query exceeded deadline of {deadline}s") from None
         if "err" in box:
@@ -862,15 +944,19 @@ class QueryRunner:
             arrays = finalize_aggs(partials, plan.agg_plans, specs,
                                    keep_raw)
         eval_post_aggs(arrays, query.post_aggregations)
-        if isinstance(query, TimeseriesQuerySpec):
-            res = self._assemble_timeseries(query, plan, arrays)
-        elif isinstance(query, GroupByQuerySpec):
-            res = self._assemble_groupby(query, plan, arrays)
-        else:
-            res = self._assemble_topn(query, plan, arrays)
+        res = self._assemble_agg(query, plan, arrays)
         res.metrics = metrics
         metrics["assemble_ms"] = (time.perf_counter() - t0) * 1000
         return res
+
+    def _assemble_agg(self, query, plan, arrays) -> QueryResult:
+        """Final-arrays -> QueryResult by query type. Shared tail of the
+        single-query agg path and the batch executor's per-leg finish."""
+        if isinstance(query, TimeseriesQuerySpec):
+            return self._assemble_timeseries(query, plan, arrays)
+        if isinstance(query, GroupByQuerySpec):
+            return self._assemble_groupby(query, plan, arrays)
+        return self._assemble_topn(query, plan, arrays)
 
     def _out_names(self, query):
         names = [a.name for a in query.aggregations]

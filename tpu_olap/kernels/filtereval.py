@@ -341,6 +341,29 @@ def compile_filter(spec, table, pool: ConstPool, virtual_exprs=None):
     return lower(spec)
 
 
+def compile_predicates(specs, table, pool: ConstPool, virtual_exprs=None):
+    """Compile SEVERAL FilterSpecs against one shared ConstPool/env:
+    every returned mask fn reads the same materialized column env, so N
+    queries' predicates cost one scan of the shared inputs plus N
+    vectorized mask combines, not N column reads. This is the
+    kernel-level standalone spelling of the shared-scan contract — the
+    batch executor itself reaches it through PhysicalPlan.key_fn (each
+    lowered leg embeds its compiled filter over the shared env); use
+    this API to compose predicates over one env by hand. None entries
+    (unfiltered legs) pass through as None; raises UnsupportedFilter on
+    the first spec that cannot lower."""
+    virtual_exprs = virtual_exprs or {}
+    return [None if s is None
+            else compile_filter(s, table, pool, virtual_exprs)
+            for s in specs]
+
+
+def eval_predicates(fns, env, consts) -> list:
+    """Evaluate compiled predicate fns over one shared env: a list of
+    bool masks (None for unfiltered legs), all from the same pass."""
+    return [None if fn is None else fn(env, consts) for fn in fns]
+
+
 # ---------------------------------------------------------------------------
 
 

@@ -232,6 +232,23 @@ def group_reduce(key, mask, env, plans, num_groups, consts):
     return out
 
 
+def group_reduce_batch(legs, consts_by_leg) -> list:
+    """Multi-plan shared-scan reduce: N query legs over ONE column env.
+
+    legs: list of (key, mask, env, plans, num_groups) — each leg's dense
+    group ids and row mask were computed from the same materialized
+    segment stream (executor.batch builds them via PhysicalPlan.key_fn),
+    so tracing this function into a single jitted program yields ONE
+    device pass in which every shared column is read once and fed to all
+    N (filter-mask, agg-plan) legs. Returns N independent partials dicts
+    (the same shape group_reduce emits), one per leg — mergeable and
+    finalizable exactly like single-query partials.
+    """
+    return [group_reduce(key, mask, env, plans, num_groups, consts)
+            for (key, mask, env, plans, num_groups), consts
+            in zip(legs, consts_by_leg)]
+
+
 def merge_partials(a: dict, b: dict, plans) -> dict:
     """Merge two partial-aggregate dicts (tree-reduce across segments; the
     same op runs as an ICI collective across chips)."""

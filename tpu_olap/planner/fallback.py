@@ -44,23 +44,30 @@ def _run_inner_stmt(s, catalog, config) -> pd.DataFrame:
     else the pandas interpreter."""
     runner = getattr(catalog, "device_runner", None)
     if runner is not None and config.fallback_derived_on_device:
-        df = runner(s)
-        # device frames render NULL numeric aggregates as None inside
-        # object columns; the interpreter's predicate evaluation (like
-        # pandas aggregation itself) expects float64 + NaN — normalize
-        # any all-numeric object column the way pandas would have
-        # produced it, so `WHERE m > 0` over a nullable max() keeps
-        # working (the "never an error" property, SURVEY.md §2 prop 2)
-        for c in df.columns:
-            if df[c].dtype == object:
-                vals = df[c][df[c].notna()]
-                if len(vals) < len(df[c]) and len(vals) and all(
-                        isinstance(v, (int, float, np.integer,
-                                       np.floating))
-                        for v in vals):
-                    df[c] = pd.to_numeric(df[c], errors="coerce")
-        return df
+        return _coerce_nullable_numeric(runner(s))
     return execute_fallback(s, catalog, config)
+
+
+def _coerce_nullable_numeric(df: pd.DataFrame) -> pd.DataFrame:
+    """Device frames render NULL numeric aggregates as None inside
+    object columns; the interpreter's predicate evaluation (like pandas
+    aggregation itself) expects float64 + NaN — normalize any
+    all-numeric object column the way pandas would have produced it, so
+    `WHERE m > 0` over a nullable max() keeps working (the "never an
+    error" property, SURVEY.md §2 prop 2). Python bool is an int
+    subclass, so booleans are EXCLUDED explicitly: a nullable BOOLEAN
+    column must stay True/False/None, not silently coerce to 1.0/0.0
+    float64 (which would survive comparisons but corrupt rendering and
+    any downstream boolean logic)."""
+    for c in df.columns:
+        if df[c].dtype == object:
+            vals = df[c][df[c].notna()]
+            if len(vals) < len(df[c]) and len(vals) and all(
+                    isinstance(v, (int, float, np.integer, np.floating))
+                    and not isinstance(v, (bool, np.bool_))
+                    for v in vals):
+                df[c] = pd.to_numeric(df[c], errors="coerce")
+    return df
 
 
 def execute_fallback(stmt, catalog, config) -> pd.DataFrame:
@@ -1417,6 +1424,19 @@ def _pfork_worker(units):
     return partials, pairs
 
 
+def _parallel_timeout_s(config, entry) -> float:
+    """Bound on the fork pool's map (ADVICE round 5): a deadlocked child
+    must trigger the safe sequential retry interactively (the 45 s
+    default), not after 15 min — but a legitimately huge parallel
+    aggregate must not be cut off either, so the bound scales with the
+    estimated scan size once the table passes ~200M rows (the default
+    then grows proportionally: 2B rows -> 450 s)."""
+    t = float(config.fallback_parallel_timeout_s)
+    rows = (getattr(entry, "parquet_rows", None) or 0) \
+        if entry is not None else 0
+    return max(t, t * rows / 200_000_000.0)
+
+
 def _parallel_chunk_partials(stmt, entry, catalog, config, time_col,
                              chunk_partial, gcols, merge_ops,
                              distinct_specs, pair_cap, dcache):
@@ -1483,9 +1503,15 @@ def _parallel_chunk_partials(stmt, entry, catalog, config, time_col,
     # the map runs — concurrent queries' parallel fallbacks overlap
     # instead of serializing behind the slowest pool
     with _PFORK_LOCK:
+        # each worker gets pair_cap // workers: the workers' in-flight
+        # distinct-pair sets coexist, so per-worker caps must SUM to the
+        # configured cap — with the full cap per worker, total in-flight
+        # pairs could transiently reach workers x pair_cap before the
+        # parent-side merge re-checks the real cap
         _PFORK_CTX = (entry, chunk_partial, join,
                       config.fallback_chunk_batch_rows,
-                      gcols, merge_ops, distinct_specs, pair_cap)
+                      gcols, merge_ops, distinct_specs,
+                      max(1, pair_cap // workers))
         try:
             pool = ctx.Pool(workers)
         except Exception:  # noqa: BLE001 — sequential retry is sound
@@ -1502,9 +1528,16 @@ def _parallel_chunk_partials(stmt, entry, catalog, config, time_col,
         # query for more than fallback_parallel_timeout_s
         with pool:
             results = pool.map_async(_pfork_worker, per_worker) \
-                .get(timeout=config.fallback_parallel_timeout_s)
+                .get(timeout=_parallel_timeout_s(config, entry))
     except FallbackError:
-        raise  # a worker's legible refusal (pair cap), not a crash
+        # a worker's pair-cap refusal fired at the DIVIDED cap
+        # (pair_cap // workers) — ambiguous about the real cap, because
+        # interleaved row groups make each worker's distinct set nearly
+        # duplicate the global universe rather than partition it. The
+        # sequential loop enforces the configured cap exactly: it either
+        # succeeds (the refusal was false) or refuses legibly at the
+        # true cap.
+        return None
     except Exception:  # noqa: BLE001 — sequential retry is sound
         return None
     partials = []
