@@ -168,8 +168,12 @@ def _setup():
     if use_pallas not in ("auto", "force", "never"):
         raise SystemExit(
             f"SSB_USE_PALLAS={use_pallas!r}: must be auto|force|never")
+    # history_limit raised: the bench slices eng.history by saved offsets
+    # (per-phase batch attribution), which a steady-state ring eviction
+    # would shift mid-run; the bench process is short-lived anyway
     eng = Engine(EngineConfig(hbm_budget_bytes=hbm_budget,
-                              use_pallas=use_pallas))
+                              use_pallas=use_pallas,
+                              history_limit=1_000_000))
     t0 = time.perf_counter()
     register_ssb_parquet(eng, paths, dims)
     ingest_s = time.perf_counter() - t0
@@ -188,7 +192,7 @@ def _setup():
     }
 
 
-def main():
+def main(span_summary: bool = False):
     eng, ctx = _setup()
     note = ctx["note"]
     backend, rows, iters = ctx["backend"], ctx["rows"], ctx["iters"]
@@ -229,6 +233,9 @@ def main():
     #               dispatch+fetch, excludes plan/lower/assemble)
     over_floor = {}  # execute minus the transport floor: the honest
     #                  per-query compute term
+    phase_ms = {}  # --span-summary: per-query per-phase p50 from the
+    #                span tree (obs.trace) — parse/plan/prepare/dispatch/
+    #                host-transfer/assemble attribution in the artifact
     for qname in sorted(QUERIES):
         sql = QUERIES[qname]
         # Warm twice: the first run compiles and observes the true group
@@ -244,6 +251,7 @@ def main():
                 res.to_csv(float_format="%.6g").encode()).hexdigest()[:16]
         times = []
         execs = []
+        phases: dict = {}
         for _ in range(iters):
             n0 = len(eng.history)
             t0 = time.perf_counter()
@@ -254,6 +262,14 @@ def main():
             fresh = [m for m in eng.history[n0:] if "execute_ms" in m]
             if fresh:
                 execs.append(fresh[-1]["execute_ms"])
+            if span_summary and eng.tracer.last is not None:
+                from tpu_olap.obs.trace import phase_totals
+                for ph, ms in phase_totals(eng.tracer.last).items():
+                    phases.setdefault(ph, []).append(ms)
+        if span_summary:
+            phase_ms[qname] = {
+                ph: round(float(np.percentile(v, 50)), 3)
+                for ph, v in sorted(phases.items())}
         detail[qname] = round(float(np.percentile(times, 50)), 3)
         spread[qname] = {"min": round(min(times), 3),
                          "max": round(max(times), 3)}
@@ -293,6 +309,8 @@ def main():
             "hbm": {"budget_bytes": ctx["hbm_budget"],
                     "bytes_in_use": ledger.bytes_in_use,
                     "evictions": ledger.evictions},
+            **({"per_query_phase_p50_ms": phase_ms}
+               if span_summary else {}),
             **({"result_digests": digests} if want_digest else {}),
         },
     }))
@@ -427,9 +445,31 @@ def _concurrency_main(n_clients: int) -> int:
     return 0 if parity_ok else 1
 
 
+def _parse_args(argv=None):
+    import argparse
+    p = argparse.ArgumentParser(
+        description="SSB benchmark: prints one JSON metric line "
+                    "(worst-case p50 across the 13 SSB queries, or the "
+                    "shared-scan batch throughput A/B with "
+                    "--concurrency). Scale/iteration knobs are env vars "
+                    "(SSB_ROWS, BENCH_ITERS, BENCH_RAM_CAP_GB, ...).")
+    p.add_argument(
+        "--concurrency", type=int, nargs="?", const=8, default=None,
+        metavar="N",
+        help="run the shared-scan batch throughput A/B with N "
+             "concurrent clients (default 8) instead of the latency "
+             "bench; banks BENCH_BATCH.json")
+    p.add_argument(
+        "--span-summary", action="store_true",
+        help="emit per-query per-phase span timings (parse/plan/"
+             "prepare/dispatch/host-transfer/assemble, from the "
+             "obs.trace span tree) into the BENCH json detail as "
+             "per_query_phase_p50_ms")
+    return p.parse_args(argv)
+
+
 if __name__ == "__main__":
-    if "--concurrency" in sys.argv:
-        i = sys.argv.index("--concurrency")
-        n = int(sys.argv[i + 1]) if len(sys.argv) > i + 1 else 8
-        sys.exit(_concurrency_main(n))
-    main()
+    args = _parse_args()
+    if args.concurrency is not None:
+        sys.exit(_concurrency_main(args.concurrency))
+    main(span_summary=args.span_summary)

@@ -225,7 +225,8 @@ def test_sql_batch_propagates_interrupt_instead_of_retrying(eng,
     single_runs = []
     monkeypatch.setattr(
         eng.runner, "_execute_batch_boxed",
-        lambda queries, table: [KeyboardInterrupt()] * len(queries))
+        lambda queries, table, query_ids=None:
+        [KeyboardInterrupt()] * len(queries))
     real = eng._execute_plan
     monkeypatch.setattr(
         eng, "_execute_plan",

@@ -1,0 +1,360 @@
+"""Observability subsystem (tpu_olap.obs): span-tree tracing, the
+metrics registry + /metrics Prometheus exposition, /debug/queries,
+EXPLAIN ANALYZE, the bounded history ring, and the metrics-contract
+every execution path honors (stable dashboard schema)."""
+
+import json
+import math
+import os
+import re
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpu_olap import Engine
+from tpu_olap.api.server import QueryServer
+from tpu_olap.executor import EngineConfig
+
+CORE_KEYS = {"query_id", "total_ms", "rows_scanned", "segments_scanned",
+             "cache_hit", "query_type", "datasource"}
+
+
+def _df(n=6000, seed=11):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "ts": pd.to_datetime("2023-03-01")
+        + pd.to_timedelta(rng.integers(0, 86400 * 90, n), unit="s"),
+        "g": rng.choice([f"g{i}" for i in range(12)], n),
+        "h": rng.choice([f"h{i}" for i in range(7)], n),
+        "v": rng.integers(0, 1000, n).astype(np.int64),
+    })
+
+
+def _engine(**kw):
+    eng = Engine(EngineConfig(**kw))
+    eng.register_table("t", _df(), time_column="ts", block_rows=1 << 11)
+    return eng
+
+
+GROUP_SQL = "SELECT g, sum(v) AS s FROM t GROUP BY g ORDER BY g"
+AGG_SQL = "SELECT sum(v) AS s, count(*) AS n FROM t"
+
+
+# ----------------------------------------------------------- span trees
+
+
+def test_explain_analyze_span_tree():
+    """EXPLAIN ANALYZE executes the query and returns its span tree as
+    rows; direct-child stage durations sum to within the root total."""
+    eng = _engine()
+    eng.sql(GROUP_SQL)  # warm so timings are steady-state
+    out = eng.sql(f"EXPLAIN ANALYZE {GROUP_SQL}")
+    assert list(out.columns) == ["span", "ms", "detail"]
+    names = [s.strip() for s in out["span"]]
+    assert names[0] == "sql"
+    for stage in ("parse", "plan", "execute", "prepare", "dispatch"):
+        assert stage in names, f"missing {stage} span"
+    root_ms = float(out["ms"][0])
+    # direct children of the root run sequentially inside it
+    kids = [float(ms) for sp, ms in zip(out["span"], out["ms"])
+            if sp.startswith("  ") and not sp.startswith("    ")]
+    assert kids and sum(kids) <= root_ms * 1.05 + 1.0
+    head = json.loads(out["detail"][0])
+    assert head["query_id"].startswith("q")
+    assert head["rows_returned"] == 12
+    # ... and the recorded history total agrees with the execute span
+    rec = eng.history[-1]
+    exec_ms = next(float(ms) for sp, ms in zip(out["span"], out["ms"])
+                   if sp.strip() == "execute")
+    assert rec["total_ms"] <= exec_ms * 1.5 + 5.0
+
+
+def test_explain_analyze_fallback_statement():
+    eng = _engine()
+    eng.register_table("dim", pd.DataFrame({"k": [1, 2, 3]}),
+                       accelerate=False)
+    out = eng.sql("EXPLAIN ANALYZE SELECT k FROM dim ORDER BY k")
+    names = [s.strip() for s in out["span"]]
+    assert "fallback" in names
+    assert eng.history[-1]["query_type"] == "fallback"
+
+
+def test_tracer_rings_bounded_and_slow_log():
+    eng = _engine(trace_history_limit=5, slow_query_ms=0.0,
+                  slow_log_limit=3)
+    for _ in range(8):
+        eng.sql(AGG_SQL)
+    snap = eng.tracer.snapshot()
+    assert len(snap["recent"]) == 5
+    assert len(snap["slow"]) == 3  # threshold 0: every query is "slow"
+    assert snap["slow_query_ms"] == 0.0
+    t = snap["recent"][0]
+    assert t["name"] == "sql" and t["duration_ms"] > 0
+    json.dumps(snap)  # the whole snapshot is JSON-serializable
+
+
+def test_tracing_disabled_is_silent():
+    eng = _engine(tracing_enabled=False)
+    out = eng.sql(GROUP_SQL)
+    assert len(out) == 12
+    assert eng.tracer.snapshot()["recent"] == []
+    # records still carry a generated query_id
+    assert eng.history[-1]["query_id"].startswith("q")
+    ea = eng.sql(f"EXPLAIN ANALYZE {AGG_SQL}")
+    assert "no trace" in ea["span"][0]
+
+
+# ------------------------------------------------------ metrics contract
+
+
+def _assert_core(rec, label):
+    missing = CORE_KEYS - set(rec)
+    assert not missing, f"{label}: record missing {sorted(missing)}"
+    json.dumps(rec)  # and it serializes
+
+
+def test_metrics_contract_all_paths():
+    """Every execution path emits the same core keys — the stable
+    dashboard schema (ISSUE 6 satellite)."""
+    eng = _engine()
+    eng.register_table("dim", pd.DataFrame({"k": [1, 2]}),
+                       accelerate=False)
+
+    eng.sql(GROUP_SQL)
+    _assert_core(eng.history[-1], "dense")
+    assert eng.history[-1]["path"] == "dense"
+
+    eng.sql(GROUP_SQL)  # warm template: compile-cache hit
+    hit_rec = eng.history[-1]
+    _assert_core(hit_rec, "cache hit")
+
+    eng.sql("SELECT k FROM dim")  # unaccelerated: fallback
+    _assert_core(eng.history[-1], "fallback")
+    assert eng.history[-1]["path"] == "fallback"
+    assert eng.history[-1]["query_type"] == "fallback"
+
+    # sparse path: force by shrinking the dense budget
+    sp = Engine(EngineConfig(dense_group_budget=4))
+    sp.register_table("t", _df(), time_column="ts", block_rows=1 << 11)
+    sp.sql("SELECT g, h, sum(v) AS s FROM t GROUP BY g, h")
+    _assert_core(sp.history[-1], "sparse")
+    assert sp.history[-1]["path"] == "sparse"
+    assert sp.history[-1].get("sparse")
+
+    # batch legs + dedup fan-out
+    outs = eng.sql_batch([GROUP_SQL, AGG_SQL, GROUP_SQL])
+    assert len(outs) == 3
+    batch_recs = [h for h in eng.history if h.get("batch_id")]
+    assert batch_recs, "no batch-leg records"
+    ids = set()
+    for rec in batch_recs:
+        _assert_core(rec, "batch leg")
+        assert rec["path"] == "batch"
+        ids.add(rec["query_id"])
+    dedups = [h for h in eng.history if h.get("batch_dedup")]
+    assert dedups, "no dedup fan-out record"
+    # every logical query keeps its own id across the fused dispatch
+    assert len(ids) == len(batch_recs)
+
+
+def test_history_ring_bounded_counters_exact():
+    eng = _engine(history_limit=6)
+    n_rows = len(_df())
+    for _ in range(15):
+        eng.sql(AGG_SQL)
+    assert len(eng.history) == 6  # ring evicted oldest
+    c = eng.counters()
+    assert c["queries"] == 15  # totals survive eviction exactly
+    assert c["rows_scanned"] == 15 * n_rows
+    assert c["by_query_type"] == {"timeseries": 15}
+    assert c["cache_hits"] >= 13  # warm template after the first runs
+
+
+def test_retry_errors_sanitized_serializable():
+    """Exception-carrying metric values become short strings at record
+    time — /status //debug payloads can never hit raw exception
+    objects (ISSUE 6 satellite)."""
+    class Unjsonable:
+        def __repr__(self):
+            return "unjsonable<" + "x" * 500 + ">"
+
+    calls = {"n": 0}
+
+    def inj(stage, attempt):
+        calls["n"] += 1
+        if calls["n"] <= 10:
+            raise RuntimeError(Unjsonable())
+
+    eng = _engine(dispatch_retries=1, fault_injector=inj)
+    out = eng.sql(GROUP_SQL)  # retries exhaust -> fallback answers
+    assert len(out) == 12
+    failed = [h for h in eng.history if h.get("failed")]
+    assert failed and failed[-1]["retry_errors"]
+    for e in failed[-1]["retry_errors"]:
+        assert isinstance(e, str) and len(e) <= 300
+    json.dumps(list(eng.history))  # every record serializes
+
+
+# ------------------------------------------------------- HTTP surfaces
+
+# Prometheus text-format line grammar: metric line or HELP/TYPE comment
+_METRIC_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\""
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\")*\})?"
+    r" (?P<value>[^ ]+)$")
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return r.headers.get("Content-Type"), r.read().decode()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def test_metrics_endpoint_prometheus_grammar():
+    """Scrape GET /metrics from a live QueryServer after a mixed
+    single/batch/fallback workload and validate every line against the
+    text-format grammar — names/labels parse, values finite, histograms
+    complete (ISSUE 6 acceptance + CI satellite)."""
+    eng = _engine()
+    eng.register_table("dim", pd.DataFrame({"k": [1, 2]}),
+                       accelerate=False)
+    eng.sql(GROUP_SQL)
+    eng.sql(GROUP_SQL)
+    eng.sql("SELECT k FROM dim")        # fallback
+    eng.sql_batch([GROUP_SQL, AGG_SQL, GROUP_SQL])  # batch + dedup
+    srv = QueryServer(eng).start()
+    try:
+        ctype, text = _get(srv.url + "/metrics")
+        assert ctype.startswith("text/plain")
+        assert "version=0.0.4" in ctype
+    finally:
+        srv.stop()
+
+    seen = set()
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            assert re.match(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* ",
+                            line), line
+            continue
+        m = _METRIC_RE.match(line)
+        assert m, f"bad exposition line: {line!r}"
+        v = float(m.group("value"))
+        assert math.isfinite(v), f"non-finite sample: {line!r}"
+        seen.add(line.split("{")[0].split(" ")[0])
+
+    # the advertised families are present after this workload
+    for name in ("tpu_olap_queries_total",
+                 "tpu_olap_query_latency_ms_bucket",
+                 "tpu_olap_query_latency_ms_count",
+                 "tpu_olap_query_latency_ms_sum",
+                 "tpu_olap_rows_scanned_total",
+                 "tpu_olap_segments_scanned_total",
+                 "tpu_olap_compile_cache_requests_total",
+                 "tpu_olap_batch_size_count",
+                 "tpu_olap_history_records"):
+        assert name in seen, f"{name} missing from /metrics"
+    # latency histogram covers the paths this workload exercised
+    for path in ("dense", "fallback", "batch"):
+        assert f'path="{path}"' in text, f"no latency series for {path}"
+
+
+def test_latency_histogram_quantiles_derivable():
+    eng = _engine()
+    for _ in range(10):
+        eng.sql(AGG_SQL)
+    hist = eng.metrics.histogram("query_latency_ms")
+    p50 = hist.quantile(0.5, query_type="timeseries", path="dense")
+    p99 = hist.quantile(0.99, query_type="timeseries", path="dense")
+    assert p50 is not None and p99 is not None
+    assert 0 < p50 <= p99
+
+
+def test_debug_queries_endpoint():
+    eng = _engine(slow_query_ms=0.0)
+    eng.sql(GROUP_SQL)
+    eng.sql(AGG_SQL)
+    srv = QueryServer(eng).start()
+    try:
+        _, body = _get(srv.url + "/debug/queries")
+        snap = json.loads(body)
+        assert snap["recent"] and snap["slow"]
+        newest = snap["recent"][0]
+        assert newest["name"] == "sql"
+        child_names = [c["name"] for c in newest["children"]]
+        assert "plan" in child_names and "execute" in child_names
+        _, body = _get(srv.url + "/debug/queries?limit=1")
+        assert len(json.loads(body)["recent"]) == 1
+        # /status still answers (and its counters are the incremental
+        # totals, not an O(history) re-sum)
+        code = _post(srv.url + "/sql", {"query": AGG_SQL})
+        assert code["rows"]
+        _, body = _get(srv.url + "/status")
+        assert json.loads(body)["counters"]["queries"] == 3
+    finally:
+        srv.stop()
+
+
+def test_batch_shared_scan_span_nesting():
+    """Fused batch legs nest under one shared-scan span in the
+    submitting trace."""
+    eng = _engine()
+    eng.sql_batch([GROUP_SQL, AGG_SQL])
+    trace = eng.tracer.last
+    assert trace is not None and trace.name == "sql_batch"
+
+    def find(span, name):
+        hits = [s for _, s in span.walk() if s.name == name]
+        return hits
+
+    shared = find(trace, "shared-scan")
+    assert shared, "no shared-scan span under the batch trace"
+    legs = [c for c in shared[0].children if c.name == "leg"]
+    assert len(legs) == 2
+    leg_ids = {leg.attrs.get("query_id") for leg in legs}
+    assert len(leg_ids) == 2  # per-leg attribution survived fusing
+
+
+def test_bench_help_advertises_span_summary():
+    """CI satellite: `bench.py --help` documents the span-summary flag
+    (argparse exits before any engine/dataset setup, so this is
+    fast)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--help"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    assert "--span-summary" in proc.stdout
+    assert "--concurrency" in proc.stdout
+
+
+def test_ssb_explain_analyze_sums():
+    """ISSUE 6 acceptance: EXPLAIN ANALYZE on an SSB query returns a
+    span tree whose stage durations sum to within the recorded
+    total."""
+    from tpu_olap.bench import QUERIES, register_ssb
+    eng = Engine()
+    register_ssb(eng, lineorder_rows=8_000, seed=3, block_rows=1 << 12)
+    eng.sql(QUERIES["q2.1"])  # warm
+    out = eng.sql(f"EXPLAIN ANALYZE {QUERIES['q2.1']}")
+    assert eng.last_plan.rewritten
+    root_ms = float(out["ms"][0])
+    kids = [float(ms) for sp, ms in zip(out["span"], out["ms"])
+            if sp.startswith("  ") and not sp.startswith("    ")]
+    assert sum(kids) <= root_ms * 1.05 + 1.0
+    rec = eng.history[-1]
+    assert rec["query_type"] in ("groupBy", "topN", "timeseries")
+    assert rec["total_ms"] <= root_ms * 1.05 + 1.0

@@ -93,5 +93,7 @@ def test_sharded_pruning_still_correct():
     out = e8.sql("SELECT count() AS n FROM f WHERE year(ts) = 1994")
     years = pd.to_datetime(df.ts).dt.year
     assert out.n[0] == int((years == 1994).sum())
-    m = e8.runner.history[-1]
+    # the last DEVICE record: a fallback-served environment (device
+    # failure) records the fallback execution after the device attempt
+    m = [h for h in e8.runner.history if "segments_total" in h][-1]
     assert m["segments_scanned"] < m["segments_total"]

@@ -6,11 +6,14 @@ QUERY), and CLEAR DRUID CACHE.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 import pandas as pd
 
 from tpu_olap.catalog import Catalog, StarSchema, TableEntry
 from tpu_olap.executor import EngineConfig, QueryRunner
+from tpu_olap.obs.trace import Trace, span as _span, use_query_id
 from tpu_olap.executor.dimplan import UnsupportedDimension
 from tpu_olap.executor.runner import QueryResult
 from tpu_olap.ir.serde import query_from_json
@@ -33,6 +36,10 @@ class Engine:
         self.catalog = Catalog()
         self.runner = QueryRunner(self.config)
         self.planner = DruidPlanner(self.catalog, self.config)
+        # observability surfaces (tpu_olap.obs): the runner owns both —
+        # it is where records complete — these aliases are the API
+        self.tracer = self.runner.tracer
+        self.metrics = self.runner.metrics
         self.last_plan = None
         # Serializes device dispatch only (the runner's compile/arg caches
         # are not concurrent and the chip has one program queue anyway,
@@ -170,15 +177,30 @@ class Engine:
 
         Statement-level verbs beyond SELECT (the reference's extended
         parser, SURVEY.md §3.1): `CLEAR DRUID CACHE [table]`,
-        `EXPLAIN DRUID REWRITE <sql>`, and
+        `EXPLAIN DRUID REWRITE <sql>`, `EXPLAIN ANALYZE <sql>`, and
         `ON DRUID DATASOURCE <ds> EXECUTE QUERY '<json>'`.
         """
+        return self._sql_traced(query)[0]
+
+    def _sql_traced(self, query: str):
+        """sql() plus the completed trace (None for statement verbs or
+        when tracing is off) — the EXPLAIN ANALYZE entry point."""
         verb = _match_verb(query)
         if verb is not None:
-            return verb(self)
-        plan = self.planner.plan(query)
-        self.last_plan = plan
-        return self._execute_plan(plan)
+            return verb(self), None
+        from tpu_olap.planner.sqlparse import parse_sql
+        with self.tracer.trace("sql") as root:
+            root.set(sql=query)
+            with root.span("parse"):
+                stmt = parse_sql(query)
+            with root.span("plan") as sp:
+                plan = self.planner.plan_stmt(stmt, query)
+                sp.set(rewritten=plan.rewritten)
+                if plan.fallback_reason:
+                    sp.set(fallback_reason=plan.fallback_reason)
+            self.last_plan = plan
+            out = self._execute_plan(plan)
+        return out, root if isinstance(root, Trace) else None
 
     def _execute_plan(self, plan) -> pd.DataFrame:
         stmt = getattr(plan, "stmt", None)
@@ -193,8 +215,9 @@ class Engine:
                 # the runner serializes dispatch internally
                 # (dispatch_lock) — and with batch_window_ms set,
                 # concurrent callers coalesce into one fused dispatch
-                res = self.runner.execute(plan.query,
-                                          plan.entry.segments)
+                with _span("execute"):
+                    res = self.runner.execute(plan.query,
+                                              plan.entry.segments)
             except _UNSUPPORTED as e:
                 plan.query = None
                 plan.fallback_reason = f"lowering failed: {e}"
@@ -211,8 +234,41 @@ class Engine:
             if res is not None:
                 # conversion bugs in _frame_from must surface, not be
                 # silently reclassified as device failures
-                return self._frame_from(plan, res)
-        return execute_fallback(plan.stmt, self.catalog, self.config)
+                with _span("render"):
+                    return self._frame_from(plan, res)
+        return self._execute_fallback_recorded(plan)
+
+    def _execute_fallback_recorded(self, plan) -> pd.DataFrame:
+        """Run the pandas fallback under a span AND a history record, so
+        the fallback path shares the dashboard metric schema (query_id /
+        total_ms / rows_scanned / ... — the observability contract) the
+        device paths emit. Failures record too, then propagate."""
+        stmt = plan.stmt
+        entry = plan.entry if plan.entry is not None \
+            else self.catalog.maybe(getattr(stmt, "table", None) or "")
+        rows = 0
+        if entry is not None:
+            rows = (entry.segments.num_rows if entry.is_accelerated
+                    else entry.materialized_rows) or 0
+        m = {"query_type": "fallback",
+             "datasource": getattr(stmt, "table", None) or "(derived)",
+             "rows_scanned": rows, "cache_hit": False}
+        if plan.fallback_reason:
+            m["fallback_reason"] = plan.fallback_reason
+        t0 = time.perf_counter()
+        with _span("fallback") as sp:
+            sp.set(reason=plan.fallback_reason)
+            try:
+                out = execute_fallback(stmt, self.catalog, self.config)
+            except Exception:
+                m["failed"] = True
+                m["total_ms"] = (time.perf_counter() - t0) * 1000
+                self.runner.record(m)
+                raise
+            m["total_ms"] = (time.perf_counter() - t0) * 1000
+            m["rows_returned"] = len(out)
+            self.runner.record(m)
+        return out
 
     def _try_grouping_sets_union(self, plan):
         """GROUPING SETS/ROLLUP/CUBE on the device path (VERDICT r4
@@ -286,41 +342,52 @@ class Engine:
         outs: list = [None] * len(queries)
         plans: dict[int, object] = {}
         groups: dict[str, list[int]] = {}
-        for i, q in enumerate(queries):
-            verb = _match_verb(q)
-            if verb is not None:
-                outs[i] = verb(self)
-                continue
-            plan = self.planner.plan(q)
-            plans[i] = plan
-            stmt = getattr(plan, "stmt", None)
-            if plan.rewritten and not (
-                    stmt is not None
-                    and getattr(stmt, "grouping_sets", None) is not None):
-                groups.setdefault(plan.entry.name, []).append(i)
-        done = set()
-        for name, idxs in groups.items():
-            if len(idxs) < 2:
-                continue
-            entry = self.catalog.get(name)
-            boxed = self.runner._execute_batch_boxed(
-                [plans[i].query for i in idxs], entry.segments)
-            for i, b in zip(idxs, boxed):
-                if isinstance(b, BaseException):
-                    if not isinstance(b, Exception):
-                        # KeyboardInterrupt/SystemExit: abort the whole
-                        # submission — retrying would turn a cancel into
-                        # double work
-                        raise b
-                    continue  # single-query path (retry+fallback) below
-                outs[i] = self._frame_from(plans[i], b)
-                done.add(i)
-        for i, plan in plans.items():
-            if i in done:
-                continue
-            outs[i] = self._execute_plan(plan)
-        if plans:
-            self.last_plan = plans[max(plans)]
+        # one query_id per logical statement, minted up front so the
+        # fused batch legs' records stay attributable (obs.trace)
+        qids = [self.tracer.new_query_id() for _ in queries]
+        with self.tracer.trace("sql_batch") as root:
+            root.set(statements=len(queries))
+            for i, q in enumerate(queries):
+                verb = _match_verb(q)
+                if verb is not None:
+                    outs[i] = verb(self)
+                    continue
+                with root.span("plan", query_id=qids[i]):
+                    plan = self.planner.plan(q)
+                plans[i] = plan
+                stmt = getattr(plan, "stmt", None)
+                if plan.rewritten and not (
+                        stmt is not None
+                        and getattr(stmt, "grouping_sets", None)
+                        is not None):
+                    groups.setdefault(plan.entry.name, []).append(i)
+            done = set()
+            for name, idxs in groups.items():
+                if len(idxs) < 2:
+                    continue
+                entry = self.catalog.get(name)
+                boxed = self.runner._execute_batch_boxed(
+                    [plans[i].query for i in idxs], entry.segments,
+                    [qids[i] for i in idxs])
+                for i, b in zip(idxs, boxed):
+                    if isinstance(b, BaseException):
+                        if not isinstance(b, Exception):
+                            # KeyboardInterrupt/SystemExit: abort the
+                            # whole submission — retrying would turn a
+                            # cancel into double work
+                            raise b
+                        continue  # single-query path (retry+fallback)
+                    outs[i] = self._frame_from(plans[i], b)
+                    done.add(i)
+            for i, plan in plans.items():
+                if i in done:
+                    continue
+                # non-fused legs run inside the sql_batch trace but must
+                # record under their OWN statement id, not the root's
+                with use_query_id(qids[i]):
+                    outs[i] = self._execute_plan(plan)
+            if plans:
+                self.last_plan = plans[max(plans)]
         return outs
 
     def _run_stmt(self, stmt) -> pd.DataFrame:
@@ -409,26 +476,11 @@ class Engine:
         return self.runner.history
 
     def counters(self) -> dict:
-        """Aggregate observability counters over the query history
-        (SURVEY.md §6 metrics: 'counters exported as a dict')."""
-        hist = self.runner.history
-        out = {
-            "queries": len(hist),
-            "rows_scanned": sum(h.get("rows_scanned", 0) for h in hist),
-            "segments_scanned": sum(h.get("segments_scanned", 0)
-                                    for h in hist),
-            "segments_pruned": sum(
-                h.get("segments_total", 0) - h.get("segments_scanned", 0)
-                for h in hist),
-            "cache_hits": sum(1 for h in hist if h.get("cache_hit")),
-            "total_ms": sum(h.get("total_ms", 0.0) for h in hist),
-        }
-        by_type: dict = {}
-        for h in hist:
-            by_type[h.get("query_type", "?")] = \
-                by_type.get(h.get("query_type", "?"), 0) + 1
-        out["by_query_type"] = by_type
-        return out
+        """Aggregate observability counters (SURVEY.md §6 metrics:
+        'counters exported as a dict') — maintained incrementally at
+        query completion (QueryRunner.record), so a /status ping is O(1)
+        and the totals stay exact after history-ring eviction."""
+        return self.runner.counters()
 
 
 # --------------------------------------------------------------------------
@@ -441,6 +493,8 @@ _CLEAR_RE = _re.compile(
     r"^\s*clear\s+druid\s+cache(?:\s+(\w+))?\s*;?\s*$", _re.I)
 _EXPLAIN_RE = _re.compile(
     r"^\s*explain\s+druid\s+rewrite\s+(.+?)\s*;?\s*$", _re.I | _re.S)
+_EXPLAIN_ANALYZE_RE = _re.compile(
+    r"^\s*explain\s+analyze\s+(.+?)\s*;?\s*$", _re.I | _re.S)
 _EXEC_RE = _re.compile(
     r"^\s*on\s+druid\s+datasource\s+(\w+)\s+execute\s+query\s+"
     r"'(.+)'\s*;?\s*$", _re.I | _re.S)
@@ -458,6 +512,10 @@ def _match_verb(query: str):
     if m:
         inner = m.group(1)
         return lambda eng: _run_explain(eng, inner)
+    m = _EXPLAIN_ANALYZE_RE.match(query)
+    if m:
+        inner = m.group(1)
+        return lambda eng: _run_explain_analyze(eng, inner)
     m = _EXEC_RE.match(query)
     if m:
         ds, body = m.group(1), m.group(2).replace("''", "'")
@@ -482,6 +540,29 @@ def _run_explain(eng: Engine, inner_sql: str) -> pd.DataFrame:
     info = eng.explain(inner_sql)
     lines = _json.dumps(info, indent=2, default=str).splitlines()
     return pd.DataFrame({"plan": lines})
+
+
+def _run_explain_analyze(eng: Engine, inner_sql: str) -> pd.DataFrame:
+    """EXPLAIN ANALYZE <sql> — the observability analog of EXPLAIN DRUID
+    REWRITE: EXECUTES the statement and returns its span tree as rows
+    (one per span, depth-indented; attrs as a JSON detail column). Stage
+    durations are wall-clock children of the root, so they sum to within
+    the root's total (obs.trace; docs/OBSERVABILITY.md)."""
+    frame, trace = eng._sql_traced(inner_sql)
+    if trace is None:
+        return pd.DataFrame({
+            "span": ["(no trace: tracing disabled or statement verb)"],
+            "ms": [0.0], "detail": ["{}"]})
+    rows = []
+    for depth, s in trace.walk():
+        detail = dict(s.attrs)
+        if depth == 0:
+            detail["query_id"] = trace.query_id
+            detail["rows_returned"] = len(frame)
+        rows.append({"span": ("  " * depth) + s.name,
+                     "ms": round(s.duration_ms or 0.0, 3),
+                     "detail": _json.dumps(detail, default=str)})
+    return pd.DataFrame(rows, columns=["span", "ms", "detail"])
 
 
 def _run_passthrough(eng: Engine, datasource: str, body: str) -> pd.DataFrame:

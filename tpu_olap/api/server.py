@@ -6,12 +6,18 @@ JDBC/ODBC is JVM plumbing with no TPU-native counterpart; the idiomatic
 equivalent is a JSON-over-HTTP surface (stdlib only, no new deps):
 
   POST /sql          {"query": "SELECT ..."}      -> {columns, rows}
-                     (statement verbs work too: CLEAR DRUID CACHE, ...)
+                     (statement verbs work too: CLEAR DRUID CACHE,
+                     EXPLAIN ANALYZE, ...)
   POST /druid/v2     native Druid query JSON      -> Druid-wire results
                      (the raw-IR passthrough, SURVEY.md §4.5 — lets
                      existing Druid clients talk to the TPU engine)
   GET  /status       engine + per-table summary + counters
   GET  /status/metadata/<table>  column metadata (segmentMetadata shape)
+  GET  /metrics      Prometheus text exposition (tpu_olap.obs.metrics:
+                     latency histograms by query_type/path, scan/cache/
+                     retry counters, HBM ledger gauges)
+  GET  /debug/queries  recent span trees + the slow-query log ring
+                     (EngineConfig.slow_query_ms; docs/OBSERVABILITY.md)
 
 Concurrency: requests run on ThreadingHTTPServer threads; only device
 dispatch serializes (Engine.device_lock — the chip has one program queue,
@@ -74,8 +80,23 @@ class QueryServer:
                 n = int(self.headers.get("Content-Length", 0))
                 return self.rfile.read(n).decode()
 
+            def _send_text(self, code: int, text: str, content_type: str):
+                body = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 try:
+                    if self.path == "/metrics":
+                        # Prometheus exposition is a text format, not
+                        # JSON — version 0.0.4 per the scrape protocol
+                        self._send_text(
+                            200, server._get_metrics(),
+                            "text/plain; version=0.0.4; charset=utf-8")
+                        return
                     self._send(200, server._get(self.path))
                 except KeyError as e:
                     self._send(404, {"error": str(e)})
@@ -136,7 +157,30 @@ class QueryServer:
                 return {"table": name, "accelerated": False}
             return {"table": name,
                     "columns": entry.segments.column_metadata()}
+        if path == "/debug/queries" or path.startswith("/debug/queries?"):
+            limit = None
+            if "?" in path:
+                from urllib.parse import parse_qs
+                qs = parse_qs(path.split("?", 1)[1])
+                if qs.get("limit"):
+                    limit = int(qs["limit"][0])
+            return self.engine.tracer.snapshot(limit)
         raise KeyError(f"unknown path {path!r}")
+
+    def _get_metrics(self) -> str:
+        """GET /metrics: refresh the point-in-time gauges from engine
+        state (counters/histograms are maintained incrementally at query
+        completion — QueryRunner.record), then render the registry."""
+        eng = self.engine
+        m = eng.metrics
+        ledger = eng.runner._hbm_ledger
+        m.gauge("hbm_bytes_in_use").set(ledger.bytes_in_use)
+        eng.runner._m_hbm_evict.set_total(ledger.evictions)
+        m.gauge("history_records",
+                "Records retained in the bounded history ring.") \
+            .set(len(eng.runner.history))
+        m.gauge("tables_registered").set(len(eng.catalog.names()))
+        return m.render()
 
     def _post(self, path: str, body: str):
         if path == "/sql":

@@ -45,6 +45,7 @@ from tpu_olap.executor.runner import QueryResult, _next_pow2
 from tpu_olap.ir.query import (GroupByQuerySpec, TimeseriesQuerySpec,
                                TopNQuerySpec)
 from tpu_olap.kernels.groupby import group_reduce_batch, merge_partials
+from tpu_olap.obs.trace import current_query_id, span as _span
 
 AGG_QUERY_TYPES = (TimeseriesQuerySpec, GroupByQuerySpec, TopNQuerySpec)
 
@@ -63,14 +64,19 @@ def fusable(plan, mesh) -> str | None:
     return None
 
 
-def run_batch(runner, queries, table) -> list:
+def run_batch(runner, queries, table, query_ids=None) -> list:
     """Execute N queries against one table, sharing scans where possible.
 
     Returns a boxed list in input order: QueryResult per success,
     the exception per failed leg (the caller — Coalescer.submit or
     Engine.sql_batch — re-raises or falls back PER QUERY, preserving the
-    'never an error' property query-by-query)."""
+    'never an error' property query-by-query). `query_ids` (parallel to
+    `queries`) carries each logical query's trace id so per-leg history
+    records stay attributable across the fused dispatch; None entries
+    get a fresh id at record time."""
     queries = list(queries)
+    if query_ids is None:
+        query_ids = [None] * len(queries)
     boxed: list = [None] * len(queries)
 
     # dedupe identical queries first: one physical pass serves every
@@ -120,6 +126,10 @@ def run_batch(runner, queries, table) -> list:
             for i in idxs:
                 boxed[i] = e
             continue
+        if query_ids[idxs[0]]:
+            # re-attribute: _execute_locked recorded under the leader's
+            # context; the history record shares this dict
+            res.metrics["query_id"] = query_ids[idxs[0]]
         if len(idxs) > 1:
             m = res.metrics
             m["batch_id"] = runner._next_batch_id()
@@ -127,7 +137,8 @@ def run_batch(runner, queries, table) -> list:
             m["batch_legs"] = 1
             m["scan_ms_shared"] = m.get("execute_ms", 0.0)
             m["agg_ms"] = m.get("execute_ms", 0.0)
-        _fan_out(runner, boxed, res, idxs, queries)
+            runner._m_batch.observe(len(idxs))
+        _fan_out(runner, boxed, res, idxs, queries, query_ids)
 
     maxq = max(2, int(runner.config.batch_max_queries))
     for cl in fused_groups:
@@ -141,14 +152,16 @@ def run_batch(runner, queries, table) -> list:
                     q, idxs, plan = group[0]
                     results = [runner._execute_locked(q, table)]
                 else:
-                    results = _run_fused(runner, table, group)
+                    results = _run_fused(runner, table, group, query_ids)
             except BaseException as e:  # noqa: BLE001 — boxed per leg
                 for _, idxs, _ in group:
                     for i in idxs:
                         boxed[i] = e
                 continue
             for (q, idxs, _), res in zip(group, results):
-                _fan_out(runner, boxed, res, idxs, queries)
+                if query_ids[idxs[0]]:
+                    res.metrics["query_id"] = query_ids[idxs[0]]
+                _fan_out(runner, boxed, res, idxs, queries, query_ids)
     return boxed
 
 
@@ -189,23 +202,32 @@ def _window_clusters(fused):
     return clusters, alone
 
 
-def _fan_out(runner, boxed, res, idxs, queries):
+def _fan_out(runner, boxed, res, idxs, queries, query_ids=None):
     """First duplicate gets the computed result; the rest share its rows
-    (the scan ran once) under their own QueryResult + history record."""
+    (the scan ran once) under their own QueryResult + history record
+    carrying its own query_id."""
     boxed[idxs[0]] = res
     for i in idxs[1:]:
-        dup = QueryResult(queries[i], res.rows, res.druid,
-                          {**res.metrics, "batch_dedup": True})
-        runner.history.append(dup.metrics)
+        m = {**res.metrics, "batch_dedup": True}
+        # a duplicate is its own logical query: never inherit the
+        # computing leg's id (record() would otherwise stamp the batch
+        # leader's trace id on every fan-out copy)
+        m["query_id"] = (query_ids[i] if query_ids and query_ids[i]
+                         else runner.tracer.new_query_id())
+        dup = QueryResult(queries[i], res.rows, res.druid, m)
+        runner.record(dup.metrics)
         boxed[i] = dup
 
 
 # ------------------------------------------------------------- fused pass
 
 
-def _run_fused(runner, table, group):
+def _run_fused(runner, table, group, query_ids=None):
     """group: >= 2 unique dense-agg legs against one table. Build the
-    union env ONCE, run ONE fused pass, finalize/assemble per leg."""
+    union env ONCE, run ONE fused pass, finalize/assemble per leg.
+    When a trace is active (the leader's — followers' traces show only
+    their coalesce wait), the fused pass appears as one `shared-scan`
+    span with every logical leg nested under it."""
     from tpu_olap.executor.results import (agg_specs_by_name, eval_post_aggs,
                                            finalize_aggs, theta_raw_fields)
 
@@ -213,9 +235,14 @@ def _run_fused(runner, table, group):
     plans = [p for _, _, p in group]
     n_logical = sum(len(idxs) for _, idxs, _ in group)
     batch_id = runner._next_batch_id()
+    runner._m_batch.observe(n_logical)
     metrics_list = [{"query_type": q.query_type, "datasource": table.name,
                      "batch_id": batch_id, "batch_size": n_logical,
                      "batch_legs": len(group)} for q, _, _ in group]
+    if query_ids is not None:
+        for (_, idxs, _), m in zip(group, metrics_list):
+            if query_ids[idxs[0]]:
+                m["query_id"] = query_ids[idxs[0]]
 
     def dispatch():
         # env build lives INSIDE the retried callable: a _dispatch retry
@@ -241,27 +268,34 @@ def _run_fused(runner, table, group):
     # shared metrics of leg 0 carry any retry_errors), under the same
     # deadline/wedge guard — a wedged device must not hang every
     # coalesced caller past query_deadline_s
-    partials_list, shared_ms, agg_ms, hit = runner._guarded_dispatch(
-        dispatch, metrics_list[0], table.name)
+    with _span("shared-scan", batch_id=batch_id, batch_legs=len(group),
+               batch_size=n_logical) as ssp:
+        partials_list, shared_ms, agg_ms, hit = runner._guarded_dispatch(
+            dispatch, metrics_list[0], table.name)
+        ssp.set(cache_hit=hit, scan_ms_shared=round(shared_ms, 3))
 
-    results = []
-    for (q, idxs, plan), m, partials, leg_ms in zip(
-            group, metrics_list, partials_list, agg_ms):
-        t0 = time.perf_counter()
-        specs = agg_specs_by_name(q.aggregations)
-        keep_raw = theta_raw_fields(q.post_aggregations)
-        arrays = finalize_aggs(partials, plan.agg_plans, specs, keep_raw)
-        eval_post_aggs(arrays, q.post_aggregations)
-        res = runner._assemble_agg(q, plan, arrays)
-        m["scan_ms_shared"] = shared_ms
-        m["agg_ms"] = leg_ms
-        m["cache_hit"] = hit
-        m["num_shards"] = 1
-        m["assemble_ms"] = (time.perf_counter() - t0) * 1000
-        m["total_ms"] = (time.perf_counter() - t_start) * 1000
-        res.metrics = m
-        runner.history.append(m)
-        results.append(res)
+        results = []
+        for (q, idxs, plan), m, partials, leg_ms in zip(
+                group, metrics_list, partials_list, agg_ms):
+            t0 = time.perf_counter()
+            with ssp.span("leg") as lsp:
+                specs = agg_specs_by_name(q.aggregations)
+                keep_raw = theta_raw_fields(q.post_aggregations)
+                arrays = finalize_aggs(partials, plan.agg_plans, specs,
+                                       keep_raw)
+                eval_post_aggs(arrays, q.post_aggregations)
+                res = runner._assemble_agg(q, plan, arrays)
+            m["scan_ms_shared"] = shared_ms
+            m["agg_ms"] = leg_ms
+            m["cache_hit"] = hit
+            m["num_shards"] = 1
+            m["assemble_ms"] = (time.perf_counter() - t0) * 1000
+            m["total_ms"] = (time.perf_counter() - t_start) * 1000
+            res.metrics = m
+            runner.record(m)
+            lsp.set(query_id=m["query_id"], query_type=m["query_type"],
+                    agg_ms=round(leg_ms, 3), duplicates=len(idxs))
+            results.append(res)
     return results
 
 
@@ -472,7 +506,7 @@ def _run_fused_numpy(runner, plans, leg_envs, valid, seg_masks, win):
 
 
 class _Pending:
-    __slots__ = ("query", "table", "event", "result", "error")
+    __slots__ = ("query", "table", "event", "result", "error", "qid")
 
     def __init__(self, query, table):
         self.query = query
@@ -480,6 +514,10 @@ class _Pending:
         self.event = threading.Event()
         self.result = None
         self.error = None
+        # capture the submitting caller's trace id: the leader executes
+        # every follower's query on its own thread, so the fused legs'
+        # history records must be re-attributed at record time
+        self.qid = current_query_id()
 
 
 class Coalescer:
@@ -530,7 +568,8 @@ class Coalescer:
                     with self.runner.dispatch_lock:
                         boxed = run_batch(self.runner,
                                           [it.query for it in items],
-                                          items[0].table)
+                                          items[0].table,
+                                          [it.qid for it in items])
                 except BaseException as e:  # noqa: BLE001 — fan out
                     boxed = [e] * len(items)
                 for it, b in zip(items, boxed):

@@ -190,6 +190,22 @@ class EngineConfig:
     # costs milliseconds.
     profile_dir: str | None = None
 
+    # observability (tpu_olap.obs): per-query span-tree tracing (obs.trace)
+    # — on by default; the cost is two perf_counter() calls per stage.
+    # trace_history_limit bounds the recent-trace ring served by
+    # GET /debug/queries; traces slower than slow_query_ms also land in the
+    # slow-query ring (slow_log_limit entries).
+    tracing_enabled: bool = True
+    trace_history_limit: int = 128
+    slow_query_ms: float = 250.0
+    slow_log_limit: int = 64
+    # QueryRunner.history ring size: per-query observability records past
+    # this evict oldest-first, so a long-running server's memory is flat.
+    # Engine.counters() stays exact regardless — totals are maintained
+    # incrementally at record time, never re-summed from (possibly
+    # evicted) history.
+    history_limit: int = 1024
+
     # Pallas fused one-hot MXU reduce (kernels.pallas_reduce): "auto" uses
     # it on the TPU backend for eligible plans, "force" uses it everywhere
     # eligible (interpret mode off-TPU — for tests), "never" disables.

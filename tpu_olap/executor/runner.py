@@ -17,6 +17,9 @@ import numpy as np
 
 from tpu_olap.executor.config import EngineConfig
 from tpu_olap.executor.dataset import DeviceDataset
+from tpu_olap.obs.metrics import MetricsRegistry
+from tpu_olap.obs.trace import (Tracer, current_query_id, short_str,
+                                span as _span)
 from tpu_olap.executor.lowering import PhysicalPlan, lower
 from tpu_olap.executor.packing import (build_packer, densify, make_layout,
                                        unpack)
@@ -51,6 +54,57 @@ class QueryDeadlineExceeded(Exception):
     (SURVEY.md §3.5): the caller falls back; the abandoned dispatch thread
     finishes (and is discarded) in the background since an in-flight XLA
     computation cannot be interrupted."""
+
+
+class HistoryRing(list):
+    """Bounded per-query history (EngineConfig.history_limit): append
+    evicts oldest-first past maxlen, so a long-running server's memory
+    no longer grows per query. A list subclass on purpose — callers
+    (bench.py, tests, tools) slice and len() it freely, and the ring is
+    small enough that the O(maxlen) front-eviction memmove is noise
+    next to any query. Aggregate counters never re-sum this structure;
+    QueryRunner.record maintains them incrementally."""
+
+    def __init__(self, maxlen: int | None = None):
+        super().__init__()
+        self.maxlen = maxlen if maxlen is None else max(1, int(maxlen))
+
+    def append(self, item):
+        super().append(item)
+        if self.maxlen is not None:
+            while len(self) > self.maxlen:
+                del self[0]
+
+
+# core metric keys every completed-query record carries, whatever path
+# served it (dense / sparse / pallas / fallback / batch leg / cache hit)
+# — the stable dashboard schema (tests/test_observability.py contract)
+CORE_METRIC_DEFAULTS = (
+    ("total_ms", 0.0), ("rows_scanned", 0), ("segments_scanned", 0),
+    ("cache_hit", False), ("query_type", "?"), ("datasource", "?"),
+)
+
+
+def sanitize_metric_value(v, _depth=0):
+    """Exception-carrying (or otherwise non-JSON) metric values -> short
+    strings AT RECORD TIME, so /status, /sql responses, and
+    /debug/queries never hit serialization failures on raw exception
+    objects. JSON-native scalars pass through untouched."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return short_str(v) if isinstance(v, str) and len(v) > 300 else v
+    if _depth < 4:
+        if isinstance(v, (list, tuple)):
+            return [sanitize_metric_value(x, _depth + 1) for x in v]
+        if isinstance(v, dict):
+            return {str(k): sanitize_metric_value(x, _depth + 1)
+                    for k, x in v.items()}
+    if isinstance(v, np.bool_):
+        return bool(v)
+    if isinstance(v, np.integer):
+        return int(v)
+    if isinstance(v, np.floating):
+        return float(v)
+    return short_str(v)
 
 
 def _evict_one(cache: dict) -> None:
@@ -93,7 +147,117 @@ class QueryRunner:
         self._active_shards = config.num_shards if config else None
         self._last_metrics: dict = {}
         self._wedged = False   # a deadline expired; re-probe before trusting
-        self.history: list = []
+        self.history = HistoryRing(self.config.history_limit)
+        # observability (tpu_olap.obs): span-tree tracer + incremental
+        # metrics registry, both fed through record() at query completion
+        self.tracer = Tracer(enabled=self.config.tracing_enabled,
+                             ring_limit=self.config.trace_history_limit,
+                             slow_ms=self.config.slow_query_ms,
+                             slow_limit=self.config.slow_log_limit)
+        self.metrics = MetricsRegistry()
+        self._totals_lock = threading.Lock()
+        self._profile_seq = 0  # profiler trace dirs outlive ring eviction
+        self._totals = {"queries": 0, "rows_scanned": 0,
+                        "segments_scanned": 0, "segments_pruned": 0,
+                        "cache_hits": 0, "total_ms": 0.0}
+        self._by_query_type: dict = {}
+        m = self.metrics
+        self._m_queries = m.counter(
+            "queries_total", "Queries completed, by type and path.",
+            ("query_type", "path"))
+        self._m_latency = m.histogram(
+            "query_latency_ms", "End-to-end query latency (ms).",
+            ("query_type", "path"))
+        self._m_rows = m.counter(
+            "rows_scanned_total", "Rows scanned across all queries.")
+        self._m_segments = m.counter(
+            "segments_scanned_total",
+            "Segments scanned across all queries.")
+        self._m_compile = m.counter(
+            "compile_cache_requests_total",
+            "Dispatches by compile-cache outcome.", ("result",))
+        self._m_retries = m.counter(
+            "dispatch_retries_total", "Device dispatch retries.")
+        self._m_deadline = m.counter(
+            "deadline_exceeded_total",
+            "Queries killed by query_deadline_s.")
+        self._m_hbm_bytes = m.gauge(
+            "hbm_bytes_in_use", "HBM ledger bytes resident.")
+        self._m_hbm_evict = m.counter(
+            "hbm_evictions_total", "HBM ledger column evictions.")
+        self._m_batch = m.histogram(
+            "batch_size", "Logical queries per shared-scan batch.",
+            buckets=(1, 2, 4, 8, 16, 32, 64))
+
+    def _metric_path(self, m: dict) -> str:
+        """Dashboard path label: which execution flavor served this
+        record (docs/OBSERVABILITY.md)."""
+        if m.get("query_type") == "fallback" or m.get("fallback"):
+            return "fallback"
+        if m.get("batch_dedup") or m.get("batch_legs", 0) > 1:
+            return "batch"
+        if m.get("sparse"):
+            return "sparse"
+        if m.get("pallas"):
+            return "pallas"
+        return "dense"
+
+    def record(self, m: dict) -> dict:
+        """The one gate every per-query observability record passes
+        through: sanitize exception-carrying values to short strings,
+        stamp the core metric keys (query_id from the active trace),
+        fold the record into the incremental totals (Engine.counters
+        stays exact after ring eviction) and the metrics registry, then
+        append to the bounded history ring. Sanitization is IN PLACE so
+        a QueryResult.metrics dict sharing this object stays the
+        consistent view."""
+        had_cache_key = "cache_hit" in m
+        for k in list(m):
+            m[k] = sanitize_metric_value(m[k])
+        m.setdefault("query_id",
+                     current_query_id() or self.tracer.new_query_id())
+        for k, v in CORE_METRIC_DEFAULTS:
+            m.setdefault(k, v)
+        qt, path = m["query_type"], self._metric_path(m)
+        m["path"] = path
+        with self._totals_lock:
+            t = self._totals
+            t["queries"] += 1
+            t["rows_scanned"] += m["rows_scanned"] or 0
+            t["segments_scanned"] += m["segments_scanned"] or 0
+            t["segments_pruned"] += max(
+                0, (m.get("segments_total", 0) or 0)
+                - (m["segments_scanned"] or 0))
+            t["cache_hits"] += 1 if m["cache_hit"] else 0
+            t["total_ms"] += m["total_ms"] or 0.0
+            self._by_query_type[qt] = self._by_query_type.get(qt, 0) + 1
+        self._m_queries.inc(query_type=qt, path=path)
+        self._m_latency.observe(m["total_ms"] or 0.0,
+                                query_type=qt, path=path)
+        self._m_rows.inc(m["rows_scanned"] or 0)
+        self._m_segments.inc(m["segments_scanned"] or 0)
+        if had_cache_key:
+            self._m_compile.inc(
+                result="hit" if m["cache_hit"] else "miss")
+        if m.get("retries"):
+            self._m_retries.inc(m["retries"])
+        if m.get("deadline_exceeded"):
+            self._m_deadline.inc()
+        if "hbm_bytes" in m:
+            self._m_hbm_bytes.set(m["hbm_bytes"])
+        if "hbm_evictions" in m:
+            self._m_hbm_evict.set_total(m["hbm_evictions"])
+        self.history.append(m)
+        return m
+
+    def counters(self) -> dict:
+        """Aggregate counters, maintained incrementally at record time —
+        exact over the full query lifetime even after history-ring
+        eviction (previously an O(history) re-sum per /status ping)."""
+        with self._totals_lock:
+            out = dict(self._totals)
+            out["by_query_type"] = dict(self._by_query_type)
+        return out
 
     @property
     def mesh(self):
@@ -164,10 +328,10 @@ class QueryRunner:
                 raise b
         return boxed
 
-    def _execute_batch_boxed(self, queries, table) -> list:
+    def _execute_batch_boxed(self, queries, table, query_ids=None) -> list:
         from tpu_olap.executor.batch import run_batch
         with self.dispatch_lock:
-            return run_batch(self, queries, table)
+            return run_batch(self, queries, table, query_ids)
 
     def _next_batch_id(self) -> int:
         self._batch_seq += 1
@@ -197,7 +361,11 @@ class QueryRunner:
             if isinstance(query, AGG_QUERY_TYPES):
                 # waits OUTSIDE dispatch_lock so concurrent callers can
                 # coalesce; the batch leader takes the lock to dispatch
-                return self._coalescer.submit(query, table)
+                with _span("coalesce") as sp:
+                    res = self._coalescer.submit(query, table)
+                    sp.set(batch_id=res.metrics.get("batch_id"),
+                           batch_size=res.metrics.get("batch_size"))
+                return res
         with self.dispatch_lock:
             return self._execute_locked(query, table)
 
@@ -234,21 +402,25 @@ class QueryRunner:
             {"query_type": query.query_type, "datasource": table.name},
             on_timeout=abandoned.set)  # its history record is discarded
 
-    def _join_abandoning(self, work, deadline: float, record: dict,
+    def _join_abandoning(self, work, deadline: float, rec: dict,
                          on_timeout=None, name="tpu-olap-dispatch"):
         """Run `work` on a fresh daemon thread, abandoning it on expiry:
-        mark the device wedged, append `record` (stamped with the
-        deadline) to history, and raise QueryDeadlineExceeded. The one
+        mark the device wedged, record `rec` (stamped with the
+        deadline), and raise QueryDeadlineExceeded. The one
         deadline/wedge join shared by the single-query path
         (_run_with_deadline) and the fused batch path
         (_guarded_dispatch); `on_timeout` runs before the wedge is set
-        (e.g. flagging the abandoned thread to discard its record)."""
+        (e.g. flagging the abandoned thread to discard its record).
+        The worker runs inside a contextvars snapshot so the caller's
+        active trace (obs.trace) spans the cross-thread dispatch."""
+        import contextvars
         import threading
         box: dict = {}
+        ctx = contextvars.copy_context()
 
         def run():
             try:
-                box["res"] = work()
+                box["res"] = ctx.run(work)
             except BaseException as e:  # noqa: BLE001 - relayed to caller
                 box["err"] = e
 
@@ -259,8 +431,8 @@ class QueryRunner:
             if on_timeout is not None:
                 on_timeout()
             self._wedged = True
-            self.history.append({**record, "deadline_exceeded": True,
-                                 "total_ms": deadline * 1000})
+            self.record({**rec, "deadline_exceeded": True,
+                         "total_ms": deadline * 1000})
             raise QueryDeadlineExceeded(
                 f"query exceeded deadline of {deadline}s") from None
         if "err" in box:
@@ -289,7 +461,7 @@ class QueryRunner:
         t.start()
         t.join(deadline)
         if not ok.is_set():
-            self.history.append({"device_probe_failed": True})
+            self.record({"device_probe_failed": True})
             raise QueryDeadlineExceeded(
                 "device still unresponsive after a deadline-expired query")
         self._wedged = False
@@ -301,7 +473,7 @@ class QueryRunner:
             ds.evict()
         self._datasets.clear()
         self._arg_cache.clear()
-        self.history.append({"device_probe_recovered": True})
+        self.record({"device_probe_recovered": True})
 
     def _execute(self, query, table, abandoned=None) -> QueryResult:
         t0 = time.perf_counter()
@@ -310,9 +482,14 @@ class QueryRunner:
             if self.config.profile_dir is not None:
                 import os
                 import jax
+                # monotonic, NOT len(history): the ring plateaus at
+                # history_limit and directory names would collide
+                with self._totals_lock:
+                    self._profile_seq += 1
+                    seq = self._profile_seq
                 trace_dir = os.path.join(
                     self.config.profile_dir,
-                    f"q{len(self.history):05d}_{query.query_type}")
+                    f"q{seq:05d}_{query.query_type}")
                 with jax.profiler.trace(trace_dir):
                     res = self._execute_inner(query, table)
                 res.metrics["profile_trace"] = trace_dir
@@ -328,13 +505,13 @@ class QueryRunner:
             m["datasource"] = table.name
             m["total_ms"] = (time.perf_counter() - t0) * 1000
             if abandoned is None or not abandoned.is_set():
-                self.history.append(m)
+                self.record(m)
             raise
         res.metrics["total_ms"] = (time.perf_counter() - t0) * 1000
         res.metrics["query_type"] = query.query_type
         res.metrics["datasource"] = table.name
         if abandoned is None or not abandoned.is_set():
-            self.history.append(res.metrics)
+            self.record(res.metrics)
         return res
 
     def _lower_cached(self, query, table):
@@ -427,6 +604,14 @@ class QueryRunner:
     def _prepare(self, plan: PhysicalPlan, metrics: dict):
         """Dataset env + validity/segment masks + scan metrics — common
         preamble of every dispatch flavor."""
+        with _span("prepare") as sp:
+            out = self._prepare_inner(plan, metrics)
+            sp.set(rows_scanned=metrics.get("rows_scanned"),
+                   segments_scanned=metrics.get("segments_scanned"),
+                   num_shards=self._active_shards or 1)
+        return out
+
+    def _prepare_inner(self, plan: PhysicalPlan, metrics: dict):
         table = plan.table
         ds = self._dataset(table)
         env = ds.env(plan.columns, plan.null_cols)
@@ -608,11 +793,12 @@ class QueryRunner:
 
         if self.config.platform == "cpu":
             t0 = time.perf_counter()
-            if win is not None:
-                env, valid, seg_mask = self._window_numpy(
-                    env, np.asarray(valid), seg_mask, win)
-            out = plan.kernel(env, np.asarray(valid), seg_mask,
-                              plan.pool.consts)
+            with _span("dispatch", cache_hit=False, num_shards=1):
+                if win is not None:
+                    env, valid, seg_mask = self._window_numpy(
+                        env, np.asarray(valid), seg_mask, win)
+                out = plan.kernel(env, np.asarray(valid), seg_mask,
+                                  plan.pool.consts)
             metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
             metrics["cache_hit"] = False
             metrics["num_shards"] = 1
@@ -634,10 +820,16 @@ class QueryRunner:
                 jitted = jax.jit(plan.kernel)
             self._jit_cache[key] = jitted
         t0 = time.perf_counter()
-        consts_dev, seg_arg = self._args_for(plan, seg_mask, mesh)
-        out = jitted(env, valid, seg_arg, consts_dev, win[0]) \
-            if win is not None else jitted(env, valid, seg_arg, consts_dev)
-        out = {k: np.asarray(v) for k, v in out.items()}
+        with _span("dispatch", cache_hit=hit,
+                   num_shards=mesh.devices.size if mesh else 1):
+            consts_dev, seg_arg = self._args_for(plan, seg_mask, mesh)
+            out = jitted(env, valid, seg_arg, consts_dev, win[0]) \
+                if win is not None \
+                else jitted(env, valid, seg_arg, consts_dev)
+        with _span("host-transfer"):
+            # jax dispatch is async: materializing to numpy is where the
+            # device round-trip actually blocks
+            out = {k: np.asarray(v) for k, v in out.items()}
         metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
         metrics["cache_hit"] = hit
         metrics["num_shards"] = mesh.devices.size if mesh else 1
@@ -715,7 +907,10 @@ class QueryRunner:
         strategy = "historicals"
         if mesh is not None:
             from tpu_olap.planner import cost as cost_mod
-            decision = cost_mod.decide(plan, self.config, mesh.devices.size)
+            with _span("cost-decision") as sp:
+                decision = cost_mod.decide(plan, self.config,
+                                           mesh.devices.size)
+                sp.set(strategy=decision.strategy)
             strategy = decision.strategy
             metrics["cost"] = decision.to_json()
         cap_limit = min(self.config.result_group_cap, plan.total_groups)
@@ -725,21 +920,26 @@ class QueryRunner:
             min(cap_limit, max(64, _next_pow2(2 * hint)))
 
         t0 = time.perf_counter()
-        consts_dev, seg_arg = self._args_for(plan, seg_mask, mesh)
-        while True:
-            jitted, layout, hit = self._packed_jit(plan, cap, mesh,
-                                                   strategy, win)
-            buf = jitted(env, valid, seg_arg, consts_dev, win[0]) \
-                if win is not None else \
-                jitted(env, valid, seg_arg, consts_dev)
-            count, idx, compact = unpack(buf, layout)
-            if count <= layout.cap:
-                break
-            if count > cap_limit:
-                metrics["result_groups"] = count
-                metrics["cache_hit"] = hit
-                return None  # config cap exceeded: unpacked re-run
-            cap = min(cap_limit, _next_pow2(count))
+        with _span("dispatch", packed=True) as dsp:
+            consts_dev, seg_arg = self._args_for(plan, seg_mask, mesh)
+            while True:
+                jitted, layout, hit = self._packed_jit(plan, cap, mesh,
+                                                       strategy, win)
+                buf = jitted(env, valid, seg_arg, consts_dev, win[0]) \
+                    if win is not None else \
+                    jitted(env, valid, seg_arg, consts_dev)
+                with _span("host-transfer"):
+                    count, idx, compact = unpack(buf, layout)
+                if count <= layout.cap:
+                    break
+                if count > cap_limit:
+                    metrics["result_groups"] = count
+                    metrics["cache_hit"] = hit
+                    dsp.set(cache_hit=hit, overflow=True)
+                    return None  # config cap exceeded: unpacked re-run
+                cap = min(cap_limit, _next_pow2(count))
+            dsp.set(cache_hit=hit,
+                    num_shards=mesh.devices.size if mesh else 1)
         self._cap_hints[base_key] = count
         metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
         metrics["cache_hit"] = hit
@@ -757,6 +957,14 @@ class QueryRunner:
         D × budget); "gather" all-gathers every chip's table. Returns
         (partials dict, count); exchange partial arrays are [D·cap_owner]
         slot tables (SENTINEL-keyed empties), others are [cap] compacts."""
+        with _span("dispatch", sparse=True) as sp:
+            out = self._run_sparse_inner(plan, metrics)
+            sp.set(cache_hit=metrics.get("cache_hit"),
+                   result_groups=metrics.get("result_groups"),
+                   num_shards=metrics.get("num_shards"))
+        return out
+
+    def _run_sparse_inner(self, plan: PhysicalPlan, metrics: dict):
         from tpu_olap.kernels.groupby import UnsupportedAggregation
 
         env, valid, seg_mask = self._prepare(plan, metrics)
@@ -894,8 +1102,11 @@ class QueryRunner:
     def _run_agg(self, query, table) -> QueryResult:
         metrics = self._last_metrics = {}
         t0 = time.perf_counter()
-        plan = self._lower_cached(query, table)
+        with _span("lower"):
+            plan = self._lower_cached(query, table)
         metrics["lower_ms"] = (time.perf_counter() - t0) * 1000
+        if getattr(plan, "pallas_reason", "off") is None:
+            metrics["pallas"] = True  # fused Pallas reduce kernel active
         specs = agg_specs_by_name(query.aggregations)
         # theta set-op post-aggs consume RAW sketch tables host-side;
         # the packed path finalizes sketches on device, so those queries
@@ -907,8 +1118,11 @@ class QueryRunner:
             out, count = self._dispatch(
                 lambda: self._run_sparse(plan, metrics), metrics, table.name)
             t0 = time.perf_counter()
-            arrays = finalize_aggs(out, plan.agg_plans, specs, keep_raw)
-            eval_post_aggs(arrays, query.post_aggregations)
+            with _span("finalize"):
+                arrays = finalize_aggs(out, plan.agg_plans, specs,
+                                       keep_raw)
+            with _span("post-agg"):
+                eval_post_aggs(arrays, query.post_aggregations)
             names = self._out_names(query)
             # present groups by sentinel mask: compact tables fill the
             # tail with SENTINEL; exchange slot tables interleave empties
@@ -916,7 +1130,8 @@ class QueryRunner:
             pm = keys != SENTINEL
             present = keys[pm].astype(np.int64)
             sub = {n: np.asarray(arrays[n])[pm] for n in names}
-            res = self._emit_groupby(query, plan, present, sub)
+            with _span("assemble"):
+                res = self._emit_groupby(query, plan, present, sub)
             res.metrics = metrics
             metrics["assemble_ms"] = (time.perf_counter() - t0) * 1000
             return res
@@ -933,7 +1148,8 @@ class QueryRunner:
                         getattr(specs.get(p.name), "round", True):
                     compact[p.name] = np.round(compact[p.name])
             t0 = time.perf_counter()
-            arrays = densify(idx, compact, layout, plan.agg_plans)
+            with _span("finalize"):
+                arrays = densify(idx, compact, layout, plan.agg_plans)
         else:
             if self.config.platform != "cpu":
                 metrics["packed"] = False  # cap overflow: unpacked re-run
@@ -941,10 +1157,13 @@ class QueryRunner:
                 lambda: self._run_partials(plan, metrics), metrics,
                 table.name)
             t0 = time.perf_counter()
-            arrays = finalize_aggs(partials, plan.agg_plans, specs,
-                                   keep_raw)
-        eval_post_aggs(arrays, query.post_aggregations)
-        res = self._assemble_agg(query, plan, arrays)
+            with _span("finalize"):
+                arrays = finalize_aggs(partials, plan.agg_plans, specs,
+                                       keep_raw)
+        with _span("post-agg"):
+            eval_post_aggs(arrays, query.post_aggregations)
+        with _span("assemble"):
+            res = self._assemble_agg(query, plan, arrays)
         res.metrics = metrics
         metrics["assemble_ms"] = (time.perf_counter() - t0) * 1000
         return res
@@ -1094,7 +1313,8 @@ class QueryRunner:
     def _run_scan(self, query, table) -> QueryResult:
         metrics = self._last_metrics = {}
         t0 = time.perf_counter()
-        plan = self._lower_cached(query, table)
+        with _span("lower"):
+            plan = self._lower_cached(query, table)
         metrics["lower_ms"] = (time.perf_counter() - t0) * 1000
         partials = self._dispatch(
             lambda: self._run_partials(plan, metrics), metrics, table.name)
@@ -1117,8 +1337,9 @@ class QueryRunner:
             offset, limit = query.paging_offset, query.page_size
             descending = query.descending
 
-        events = self._gather_rows(table, mask, cols, offset, limit,
-                                   descending)
+        with _span("assemble"):
+            events = self._gather_rows(table, mask, cols, offset, limit,
+                                       descending)
         metrics["assemble_ms"] = (time.perf_counter() - t0) * 1000
 
         if isinstance(query, ScanQuerySpec):

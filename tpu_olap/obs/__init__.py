@@ -1,0 +1,17 @@
+"""Observability — tracing spans + metrics registry (SURVEY.md §6).
+
+The reference stack leaned on Druid broker metrics and the Spark query UI
+to explain where an accelerated query spent its time; this package is the
+in-process analog: `trace` yields a per-query span tree (parse → plan →
+lower → prepare → dispatch → host-transfer → finalize → post-agg →
+assemble, with batch legs nested under their shared-scan span), `metrics`
+maintains incrementally-updated counters/gauges/histograms rendered in
+Prometheus text exposition format. No new dependencies — monotonic clocks,
+contextvars propagation, stdlib formatting only.
+"""
+
+from tpu_olap.obs.metrics import (Counter, Gauge, Histogram,  # noqa: F401
+                                  LATENCY_BUCKETS_MS, MetricsRegistry)
+from tpu_olap.obs.trace import (NULL_SPAN, Span, Trace,  # noqa: F401
+                                Tracer, current_query_id, current_span,
+                                span)
