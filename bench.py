@@ -192,7 +192,59 @@ def _setup():
     }
 
 
-def main(span_summary: bool = False):
+class _OneDispatchFault:
+    """bench --inject-faults: when armed, fail exactly the FIRST
+    dispatch attempt of the next query — the retry layer answers, and
+    the wall-clock difference vs the clean run is the recovery cost
+    (cache purge + re-upload + recompile where needed)."""
+
+    stages = ("dispatch",)
+
+    def __init__(self):
+        self.armed = False
+
+    def __call__(self, stage, attempt):
+        if self.armed and attempt == 0:
+            self.armed = False
+            raise RuntimeError("bench-injected dispatch fault")
+
+
+def _fault_overhead(eng, iters: int, note):
+    """Per-query p50 with one injected dispatch fault per execution
+    (banked next to the clean p50 so robustness cost shows up in the
+    perf trajectory instead of being invisible). Requires
+    dispatch_retries >= 1 (the engine default) so the retry — not the
+    pandas fallback — answers."""
+    from tpu_olap.bench import QUERIES
+
+    inj = _OneDispatchFault()
+    prev = eng.config.fault_injector
+    eng.config.fault_injector = inj
+    fault_ms, fell_back = {}, {}
+    try:
+        for qname in sorted(QUERIES):
+            sql = QUERIES[qname]
+            times = []
+            n_fb = 0
+            for _ in range(iters):
+                n0 = len(eng.history)
+                inj.armed = True
+                t0 = time.perf_counter()
+                eng.sql(sql)
+                times.append((time.perf_counter() - t0) * 1000)
+                n_fb += sum(1 for m in eng.history[n0:]
+                            if m.get("query_type") == "fallback")
+            fault_ms[qname] = round(float(np.percentile(times, 50)), 3)
+            if n_fb:
+                fell_back[qname] = n_fb
+            note(f"{qname} faulted p50={fault_ms[qname]}ms"
+                 + (f" (fallback x{n_fb})" if n_fb else ""))
+    finally:
+        eng.config.fault_injector = prev
+    return fault_ms, fell_back
+
+
+def main(span_summary: bool = False, inject_faults: int | None = None):
     eng, ctx = _setup()
     note = ctx["note"]
     backend, rows, iters = ctx["backend"], ctx["rows"], ctx["iters"]
@@ -281,6 +333,20 @@ def main(span_summary: bool = False):
              f"[{spread[qname]['min']}..{spread[qname]['max']}] "
              f"exec={exec_ms.get(qname)}ms")
 
+    fault_detail = None
+    if inject_faults:
+        fault_ms, fell_back = _fault_overhead(eng, inject_faults, note)
+        overhead = {q: round(max(0.0, fault_ms[q] - detail[q]), 3)
+                    for q in fault_ms}
+        fault_detail = {
+            "iters": inject_faults,
+            "per_query_p50_fault_ms": fault_ms,
+            "per_query_recovery_overhead_ms": overhead,
+            "worst_recovery_overhead_ms": round(
+                max(overhead.values()), 3),
+            **({"fallback_served": fell_back} if fell_back else {}),
+        }
+
     ledger = eng.runner._hbm_ledger
     worst = max(detail.values())
     print(json.dumps({
@@ -311,6 +377,8 @@ def main(span_summary: bool = False):
                     "evictions": ledger.evictions},
             **({"per_query_phase_p50_ms": phase_ms}
                if span_summary else {}),
+            **({"fault_injection": fault_detail}
+               if fault_detail else {}),
             **({"result_digests": digests} if want_digest else {}),
         },
     }))
@@ -465,6 +533,14 @@ def _parse_args(argv=None):
              "prepare/dispatch/host-transfer/assemble, from the "
              "obs.trace span tree) into the BENCH json detail as "
              "per_query_phase_p50_ms")
+    p.add_argument(
+        "--inject-faults", type=int, nargs="?", const=3, default=None,
+        metavar="N",
+        help="after the clean timed runs, re-time each SSB query N "
+             "times (default 3) with one injected dispatch fault per "
+             "execution; banks per-query faulted p50 and the recovery "
+             "overhead (faulted minus clean) into the BENCH json "
+             "detail as fault_injection (docs/RESILIENCE.md)")
     return p.parse_args(argv)
 
 
@@ -472,4 +548,4 @@ if __name__ == "__main__":
     args = _parse_args()
     if args.concurrency is not None:
         sys.exit(_concurrency_main(args.concurrency))
-    main(span_summary=args.span_summary)
+    main(span_summary=args.span_summary, inject_faults=args.inject_faults)

@@ -16,6 +16,15 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`; register the marker so the soak
+    # variants (e.g. tests/test_chaos_recovery.py) deselect cleanly
+    # without an unknown-marker warning
+    config.addinivalue_line(
+        "markers", "slow: out-of-tier-1 soak tests (deselected by "
+        "-m 'not slow')")
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(42)

@@ -1,0 +1,486 @@
+"""Resilience layer (tpu_olap.resilience; docs/RESILIENCE.md):
+admission control, device circuit breaker with degraded-mode serving,
+the structured error taxonomy, generalized fault-injection sites, and
+the HTTP contract (429 / 503+Retry-After / 504 / 200-after-heal) plus
+health endpoints and graceful server drain."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpu_olap import Engine
+from tpu_olap.api.server import QueryServer
+from tpu_olap.executor import EngineConfig
+from tpu_olap.executor.runner import QueryDeadlineExceeded
+from tpu_olap.planner.fallback import FallbackError
+from tpu_olap.resilience import (AdmissionController, BreakerOpen,
+                                 CircuitBreaker, FaultInjector,
+                                 InternalError, QueryError, QueryShed,
+                                 UserError)
+
+
+def _df(n=4096, seed=9):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "ts": pd.to_datetime("2021-01-01")
+        + pd.to_timedelta(rng.integers(0, 86400 * 30, n), unit="s"),
+        "g": rng.choice(["x", "y", "z"], n),
+        "v": rng.integers(0, 100, n).astype(np.int64),
+    })
+
+
+SQL = "SELECT g, sum(v) AS s, count(*) AS n FROM t GROUP BY g ORDER BY g"
+
+
+def _register(eng, **kw):
+    eng.register_table("t", _df(), time_column="ts", block_rows=512,
+                       **kw)
+
+
+def _wait_until(pred, timeout_s=10.0, every_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(every_s)
+    return pred()
+
+
+# ------------------------------------------------------- error taxonomy
+
+
+def test_error_taxonomy_contract():
+    shed = QueryShed("full", reason="queue_full")
+    assert shed.http_status == 429 and shed.retriable
+    assert shed.to_json() == {"error": "full", "code": "shed",
+                              "retriable": True}
+    bo = BreakerOpen("open", retry_after_s=2.5)
+    assert bo.http_status == 503 and bo.retriable
+    assert bo.retry_after_s == 2.5
+    # the pre-existing exceptions joined the taxonomy
+    assert issubclass(QueryDeadlineExceeded, QueryError)
+    assert QueryDeadlineExceeded.http_status == 504
+    assert QueryDeadlineExceeded.retriable
+    assert issubclass(FallbackError, QueryError)
+    assert FallbackError.http_status == 400
+    # double inheritance keeps legacy except-clauses working
+    assert isinstance(UserError("x"), ValueError)
+    assert isinstance(InternalError("x"), RuntimeError)
+
+
+# ---------------------------------------------------- admission control
+
+
+def _occupy(ac):
+    """Hold one slot on a helper thread until the returned event set."""
+    entered, release = threading.Event(), threading.Event()
+
+    def hold():
+        with ac.slot():
+            entered.set()
+            release.wait(timeout=30)
+
+    t = threading.Thread(target=hold, daemon=True)
+    t.start()
+    assert entered.wait(5)
+    return release, t
+
+
+def test_admission_queue_full_sheds():
+    ac = AdmissionController(max_inflight=1, queue_limit=0)
+    release, t = _occupy(ac)
+    try:
+        with pytest.raises(QueryShed) as ei:
+            with ac.slot():
+                pass
+        assert ei.value.reason == "queue_full"
+        assert ei.value.http_status == 429
+    finally:
+        release.set()
+        t.join(timeout=10)
+    with ac.slot():  # the slot is reusable after release
+        pass
+    assert ac.snapshot()["inflight"] == 0
+
+
+def test_admission_deadline_budget_sheds_at_the_door():
+    ac = AdmissionController(max_inflight=1, queue_limit=8)
+    release, t = _occupy(ac)
+    try:
+        # expected wait (EWMA-seeded ~50 ms) >> 1 µs budget: shed
+        # immediately instead of queueing toward a certain timeout
+        with pytest.raises(QueryShed) as ei:
+            with ac.slot(budget_s=1e-6):
+                pass
+        assert ei.value.reason == "deadline_budget"
+    finally:
+        release.set()
+        t.join(timeout=10)
+
+
+def test_admission_waits_then_admits():
+    ac = AdmissionController(max_inflight=1, queue_limit=8)
+    release, t = _occupy(ac)
+    threading.Timer(0.2, release.set).start()
+    t0 = time.perf_counter()
+    with ac.slot(budget_s=30.0):
+        waited = time.perf_counter() - t0
+    t.join(timeout=10)
+    assert 0.05 < waited < 10.0  # queued until the holder released
+
+
+def test_admission_reentrant_and_disabled():
+    ac = AdmissionController(max_inflight=1, queue_limit=0)
+    with ac.slot():
+        with ac.slot():  # nested hold on one thread: free, no deadlock
+            assert ac.snapshot()["inflight"] == 1
+    off = AdmissionController(max_inflight=0, queue_limit=0)
+    with off.slot():  # disabled: a no-op
+        assert off.snapshot()["inflight"] == 0
+
+
+# ----------------------------------------------------- circuit breaker
+
+
+def test_breaker_trips_and_healer_closes():
+    probe_ok = {"v": False}
+    br = CircuitBreaker(failure_threshold=2, cooldown_s=0.1,
+                        probe=lambda: probe_ok["v"])
+    br.record_failure()
+    assert br.state == "closed"
+    br.record_failure()
+    assert br.state == "open"
+    with pytest.raises(BreakerOpen) as ei:
+        br.check()
+    assert ei.value.http_status == 503
+    assert ei.value.retry_after_s >= 0
+    time.sleep(0.4)  # healer probed (False) at least once: still open
+    assert br.state in ("open", "half_open")
+    probe_ok["v"] = True
+    assert _wait_until(lambda: br.state == "closed", 5.0)
+    br.check()  # closed: no raise
+
+
+def test_breaker_success_resets_consecutive():
+    br = CircuitBreaker(failure_threshold=2, cooldown_s=5.0)
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    assert br.state == "closed"  # never two CONSECUTIVE failures
+    br.close()
+
+
+def test_breaker_disabled():
+    br = CircuitBreaker(failure_threshold=0, cooldown_s=1.0)
+    for _ in range(10):
+        br.record_failure()
+    br.check()  # disabled: never raises
+    assert br.state == "closed"
+
+
+# ------------------------------------- breaker-open degraded serving
+
+
+def test_breaker_open_serves_fallback_with_path():
+    """Acceptance: breaker forced open via injected consecutive dispatch
+    faults; a fallback-capable GROUP BY then returns frame-identical
+    results to a healthy engine, recorded as path="fallback_breaker"."""
+
+    def always_fail(stage, attempt):
+        raise RuntimeError("injected device loss")
+
+    eng = Engine(EngineConfig(dispatch_retries=0,
+                              breaker_failure_threshold=2,
+                              breaker_open_cooldown_s=30.0,
+                              fault_injector=always_fail))
+    _register(eng)
+    try:
+        for _ in range(2):  # two terminal failures trip the breaker
+            eng.sql(SQL)    # served by the ordinary device-failure
+            #                 fallback, so no error surfaces
+        assert eng.runner.breaker.state == "open"
+
+        got = eng.sql(SQL)  # breaker open: degraded-but-correct
+        rec = eng.runner.history[-1]
+        assert rec["path"] == "fallback_breaker"
+        assert rec["query_type"] == "fallback"
+        assert rec["fallback_reason"].startswith("breaker open")
+        assert eng.last_plan.fallback_reason.startswith("breaker open")
+        assert eng.runner._m_degraded.value() == 1
+        # no dispatch was attempted: the device stayed untouched
+        ref = Engine()
+        ref.register_table("t", _df(), time_column="ts", block_rows=512)
+        pd.testing.assert_frame_equal(got, ref.sql(SQL))
+        # the rest are legibly refused when no fallback exists: the raw
+        # IR passthrough has no interpreter equivalent
+        with pytest.raises(BreakerOpen):
+            eng.execute_ir({"queryType": "timeseries", "dataSource": "t",
+                            "granularity": "all",
+                            "aggregations": [{"type": "longSum",
+                                              "name": "s",
+                                              "fieldName": "v"}]})
+    finally:
+        eng.runner.breaker.close()  # stop the healer thread
+
+
+def test_breaker_metrics_exported():
+    eng = Engine(EngineConfig(breaker_failure_threshold=1,
+                              breaker_open_cooldown_s=30.0,
+                              dispatch_retries=0,
+                              fault_injector=lambda s, a: (_ for _ in ())
+                              .throw(RuntimeError("boom"))))
+    _register(eng)
+    try:
+        eng.sql(SQL)  # one failure trips (threshold 1); fallback answers
+        text = eng.metrics.render()
+        assert "tpu_olap_breaker_state 2" in text
+        assert 'tpu_olap_breaker_transitions_total{state="open"} 1' \
+            in text
+        assert "tpu_olap_admission_queue_depth 0" in text
+    finally:
+        eng.runner.breaker.close()
+
+
+# ------------------------------------------- generalized fault sites
+
+
+def test_host_transfer_fault_rides_dispatch_retry():
+    inj = FaultInjector(stages={"host-transfer"}, fail_calls={1})
+    eng = Engine(EngineConfig(dispatch_retries=1, fault_injector=inj))
+    _register(eng)
+    got = eng.sql(SQL)
+    assert eng.runner.history[-1]["retries"] == 1
+    assert inj.by_stage == {"host-transfer": 1}
+    ref = Engine()
+    ref.register_table("t", _df(), time_column="ts", block_rows=512)
+    pd.testing.assert_frame_equal(got, ref.sql(SQL))
+
+
+def test_ingest_fault_site_aborts_registration():
+    inj = FaultInjector(stages={"ingest"}, fail_calls={1})
+    eng = Engine(EngineConfig(fault_injector=inj))
+    with pytest.raises(RuntimeError, match="injected fault"):
+        _register(eng)
+    assert "t" not in eng.catalog.names()  # nothing half-registered
+    _register(eng)  # the retry (call 2) succeeds
+    assert len(eng.sql(SQL)) == 3
+
+
+def test_reprobe_fault_site_fails_probe():
+    inj = FaultInjector(stages={"reprobe"}, rate=1.0)
+    eng = Engine(EngineConfig(fault_injector=inj))
+    assert eng.runner._probe_device(0.5) is False
+    eng.config.fault_injector = None
+    assert eng.runner._probe_device(10.0) is True
+
+
+def test_batch_leg_fault_falls_back_per_query():
+    inj = FaultInjector(stages={"batch-leg"}, fail_calls={1})
+    eng = Engine(EngineConfig(fault_injector=inj))
+    _register(eng)
+    sqls = [SQL, "SELECT sum(v) AS s, count(*) AS n FROM t WHERE v < 50"]
+    ref = [eng.sql(q) for q in sqls]  # warm, no faults (sites unarmed
+    #                                   until the fused path runs legs)
+    outs = eng.sql_batch(sqls)
+    assert inj.by_stage.get("batch-leg") == 1
+    for got, want in zip(outs, ref):
+        pd.testing.assert_frame_equal(got, want)
+
+
+def test_legacy_injector_fires_only_at_dispatch():
+    seen = []
+
+    def inj(stage, attempt):
+        seen.append(stage)
+
+    eng = Engine(EngineConfig(fault_injector=inj))
+    _register(eng)
+    eng.sql(SQL)
+    assert seen and set(seen) == {"dispatch"}
+
+
+# ------------------------------------------------------- HTTP surface
+
+
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get_status(url, timeout=10):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def test_healthz_readyz():
+    eng = Engine(EngineConfig(breaker_failure_threshold=2,
+                              breaker_open_cooldown_s=30.0))
+    _register(eng)
+    srv = QueryServer(eng).start()
+    try:
+        code, body, _ = _get_status(srv.url + "/healthz")
+        assert code == 200 and body["status"] == "ok"
+        code, body, _ = _get_status(srv.url + "/readyz")
+        assert code == 200 and body["ready"] is True
+        # trip the breaker: readiness goes red, liveness stays green
+        eng.runner.breaker.record_failure()
+        eng.runner.breaker.record_failure()
+        code, body, _ = _get_status(srv.url + "/readyz")
+        assert code == 503 and body["ready"] is False
+        assert body["breaker"] == "open"
+        code, _, _ = _get_status(srv.url + "/healthz")
+        assert code == 200
+        eng.runner.breaker.close()
+        code, body, _ = _get_status(srv.url + "/readyz")
+        assert code == 200 and body["ready"] is True
+        status = _get_status(srv.url + "/status")[1]
+        assert status["resilience"]["breaker"] == "closed"
+    finally:
+        eng.runner.breaker.close()
+        srv.stop()
+
+
+class _ContractInjector:
+    """Stateful injector for the HTTP contract test: one object, four
+    modes, armed between steps from the test body."""
+
+    stages = {"dispatch", "reprobe"}
+
+    def __init__(self):
+        self.mode = None
+        self.release = threading.Event()
+
+    def __call__(self, stage, attempt):
+        if self.mode == "stall" and stage == "dispatch":
+            self.release.wait(timeout=30)
+        elif self.mode == "sleep" and stage == "dispatch":
+            time.sleep(2.0)
+        elif self.mode == "raise":
+            raise RuntimeError(f"injected device loss at {stage}")
+
+
+def test_http_contract_shed_breaker_deadline_heal():
+    """Acceptance: the full HTTP resilience contract on a live server —
+    429 on shed, 504 on deadline, 503 + Retry-After while the breaker
+    is open, then 200 after the healer's half-open probe closes it."""
+    inj = _ContractInjector()
+    eng = Engine(EngineConfig(
+        dispatch_retries=0, fallback_on_device_failure=False,
+        max_inflight_dispatches=1, admission_queue_limit=0,
+        breaker_failure_threshold=2, breaker_open_cooldown_s=0.5,
+        fault_injector=inj))
+    _register(eng)
+    want = eng.sql(SQL)  # warm the compile cache before arming faults
+    srv = QueryServer(eng).start()
+    try:
+        # --- 429: a stalled dispatch holds the only slot; queue_limit=0
+        # sheds the next arrival immediately
+        inj.mode = "stall"
+        t = threading.Thread(target=_post, args=(
+            srv.url + "/sql", {"query": SQL}), kwargs={"timeout": 60})
+        t.start()
+        assert _wait_until(
+            lambda: eng.runner.admission.snapshot()["inflight"] == 1, 10)
+        code, body, _ = _get_status(srv.url + "/status")  # not gated
+        assert code == 200
+        code, body, _ = _post_status(srv.url + "/sql", {"query": SQL})
+        assert code == 429
+        assert body["code"] == "shed" and body["retriable"] is True
+        inj.release.set()
+        t.join(timeout=60)
+        inj.mode = None
+
+        # --- 504: a wedged dispatch exceeds the deadline and no
+        # fallback is available
+        eng.config.query_deadline_s = 0.4
+        inj.mode = "sleep"
+        code, body, _ = _post_status(srv.url + "/sql", {"query": SQL})
+        assert code == 504
+        assert body["code"] == "deadline_exceeded"
+        assert body["retriable"] is True
+
+        # --- 503 + Retry-After: consecutive failures trip the breaker
+        # (the deadline above already counted one); "raise" mode also
+        # fails the reprobe so the healer cannot close it yet
+        inj.mode = "raise"
+        saw = []
+        for _ in range(6):
+            code, body, headers = _post_status(srv.url + "/sql",
+                                               {"query": SQL})
+            saw.append(code)
+            if code == 503:
+                break
+        assert 503 in saw, saw
+        assert body["code"] == "breaker_open"
+        assert int(headers["Retry-After"]) >= 1
+        code, _, _ = _get_status(srv.url + "/readyz")
+        assert code == 503
+
+        # --- 200 after heal: disarm the faults; the healer's half-open
+        # probe closes the breaker within a cooldown cycle or two
+        eng.config.query_deadline_s = None
+        inj.mode = None
+        assert _wait_until(
+            lambda: _get_status(srv.url + "/readyz")[0] == 200, 20)
+        out = _post(srv.url + "/sql", {"query": SQL})
+        assert [r["g"] for r in out["rows"]] == list(want["g"])
+    finally:
+        inj.release.set()
+        inj.mode = None
+        eng.runner.breaker.close()
+        srv.stop()
+        time.sleep(0.1)  # let the abandoned sleep-dispatch thread drain
+
+
+def _post_status(url, payload, timeout=30):
+    try:
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def test_server_stop_drains_inflight_request():
+    """QueryServer.stop() must let a mid-flight query finish (bounded)
+    instead of severing its response at shutdown()."""
+    inj = _ContractInjector()
+    eng = Engine(EngineConfig(fault_injector=inj))
+    _register(eng)
+    eng.sql(SQL)  # warm
+    srv = QueryServer(eng).start()
+    out = {}
+
+    def slow_post():
+        try:
+            out["resp"] = _post(srv.url + "/sql", {"query": SQL},
+                                timeout=60)
+        except Exception as e:  # noqa: BLE001 — inspected below
+            out["err"] = e
+
+    inj.mode = "stall"
+    t = threading.Thread(target=slow_post)
+    t.start()
+    assert _wait_until(lambda: srv._inflight >= 1, 10)
+    threading.Timer(0.4, inj.release.set).start()
+    t0 = time.perf_counter()
+    srv.stop(drain_timeout_s=15)
+    stopped_in = time.perf_counter() - t0
+    t.join(timeout=30)
+    assert "err" not in out, out.get("err")
+    assert [r["g"] for r in out["resp"]["rows"]] == ["x", "y", "z"]
+    assert stopped_in < 12  # drained, not hung

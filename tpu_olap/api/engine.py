@@ -22,6 +22,9 @@ from tpu_olap.kernels.groupby import UnsupportedAggregation
 from tpu_olap.kernels.timebucket import UnsupportedGranularity
 from tpu_olap.planner import DruidPlanner
 from tpu_olap.planner.fallback import FallbackError, execute_fallback
+from tpu_olap.resilience.errors import (BreakerOpen, QueryShed,
+                                        UserError)
+from tpu_olap.resilience.faults import maybe_inject
 from tpu_olap.segments.ingest import (DEFAULT_BLOCK_ROWS, ingest_arrow,
                                       ingest_pandas, ingest_parquet,
                                       ingest_parquet_stream)
@@ -90,6 +93,10 @@ class Engine:
         query interval); "auto" (default) picks the finest granularity
         the table can amortize; None disables partitioning.
         """
+        # "ingest" fault site (resilience.faults): a raised fault aborts
+        # registration before any segment state is built, so a failed
+        # ingest never leaves a half-registered table behind
+        maybe_inject(self.config, "ingest", 0)
         column_map = dict(column_map) if column_map else None
         if column_map and time_column in column_map:
             time_column = column_map[time_column]
@@ -221,6 +228,20 @@ class Engine:
             except _UNSUPPORTED as e:
                 plan.query = None
                 plan.fallback_reason = f"lowering failed: {e}"
+            except QueryShed:
+                # admission shed = the system is OVERLOADED: routing the
+                # query to the (slower) interpreter would amplify the
+                # overload. Propagate -> HTTP 429, client retries later.
+                raise
+            except BreakerOpen as e:
+                # breaker open = the DEVICE is sick, the host is fine:
+                # degraded-but-correct serving from the interpreter,
+                # stamped path="fallback_breaker" in the record schema.
+                if not self.config.fallback_on_device_failure:
+                    raise
+                plan.query = None
+                plan.breaker_fallback = True
+                plan.fallback_reason = f"breaker open: {e}"
             except Exception as e:
                 # Structural "never an error" guarantee (SURVEY.md §2
                 # property 2): dispatch retries exhausted on a
@@ -255,6 +276,8 @@ class Engine:
              "rows_scanned": rows, "cache_hit": False}
         if plan.fallback_reason:
             m["fallback_reason"] = plan.fallback_reason
+        if getattr(plan, "breaker_fallback", False):
+            m["fallback_breaker"] = True
         t0 = time.perf_counter()
         with _span("fallback") as sp:
             sp.set(reason=plan.fallback_reason)
@@ -439,7 +462,7 @@ class Engine:
             query = query_from_json(query)
         entry = self.catalog.get(query.data_source)
         if not entry.is_accelerated:
-            raise ValueError(
+            raise UserError(
                 f"table {query.data_source!r} is not accelerated")
         # the runner locks (or coalesces) internally; holding the lock
         # here would deadlock a coalesced submission against its leader

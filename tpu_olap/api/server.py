@@ -15,16 +15,33 @@ equivalent is a JSON-over-HTTP surface (stdlib only, no new deps):
   GET  /status/metadata/<table>  column metadata (segmentMetadata shape)
   GET  /metrics      Prometheus text exposition (tpu_olap.obs.metrics:
                      latency histograms by query_type/path, scan/cache/
-                     retry counters, HBM ledger gauges)
+                     retry counters, HBM ledger gauges, resilience
+                     gauges/counters)
   GET  /debug/queries  recent span trees + the slow-query log ring
                      (EngineConfig.slow_query_ms; docs/OBSERVABILITY.md)
+  GET  /healthz      liveness: 200 while the process serves requests
+  GET  /readyz       readiness: 503 while the device circuit breaker is
+                     open or the device is wedged — tells a load
+                     balancer to stop ROUTING to a sick replica instead
+                     of queueing onto it (docs/RESILIENCE.md)
 
-Concurrency: requests run on ThreadingHTTPServer threads; only device
-dispatch serializes (Engine.device_lock — the chip has one program queue,
-SURVEY.md §3.5 P1). Fallback-path queries, statement verbs, and status
-endpoints proceed while a device query runs, and
-EngineConfig.query_deadline_s bounds how long any one dispatch can wedge
-the queue.
+Error contract (docs/RESILIENCE.md): failures carry the structured
+taxonomy (tpu_olap.resilience.errors) — the body is {"error", "code",
+"retriable"} and the status distinguishes retry-later from
+your-request-is-wrong:
+
+  400  user error (bad SQL / unknown path / unsupported statement)
+  429  admission shed (dispatch queue full or deadline budget < wait)
+  503  circuit breaker open (Retry-After: cooldown remaining)
+  504  query deadline exceeded with no fallback available
+  500  internal / unclassified
+
+Concurrency: requests run on ThreadingHTTPServer threads; device
+dispatch admission is bounded (EngineConfig.max_inflight_dispatches /
+admission_queue_limit) so a traffic spike sheds with 429 instead of
+piling unboundedly onto the device lock. stop() drains gracefully:
+stops accepting, waits for in-flight handlers up to a bounded timeout,
+then force-closes.
 """
 
 from __future__ import annotations
@@ -32,9 +49,12 @@ from __future__ import annotations
 import json
 import math
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import pandas as pd
+
+from tpu_olap.resilience.errors import QueryError
 
 
 def _jsonable(x):
@@ -62,19 +82,37 @@ class QueryServer:
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0):
         self.engine = engine
         server = self
+        # graceful-drain bookkeeping: handlers register in/out so stop()
+        # can wait for mid-flight responses instead of severing them
+        self._inflight = 0
+        self._inflight_cond = threading.Condition()
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet; engine.history observes
                 pass
 
-            def _send(self, code: int, payload):
+            def _send(self, code: int, payload, headers=()):
                 body = json.dumps(_jsonable(payload), default=str,
                                   allow_nan=False).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _send_query_error(self, e: QueryError):
+                """Structured taxonomy mapping: status from the error,
+                machine-readable body, Retry-After while the breaker
+                cools down."""
+                headers = []
+                retry_after = getattr(e, "retry_after_s", None)
+                if retry_after is not None:
+                    headers.append(
+                        ("Retry-After",
+                         str(max(1, int(math.ceil(retry_after))))))
+                self._send(e.http_status, e.to_json(), headers)
 
             def _body(self):
                 n = int(self.headers.get("Content-Length", 0))
@@ -89,6 +127,7 @@ class QueryServer:
                 self.wfile.write(body)
 
             def do_GET(self):
+                server._enter()
                 try:
                     if self.path == "/metrics":
                         # Prometheus exposition is a text format, not
@@ -97,19 +136,38 @@ class QueryServer:
                             200, server._get_metrics(),
                             "text/plain; version=0.0.4; charset=utf-8")
                         return
+                    if self.path == "/healthz":
+                        self._send(200, {"status": "ok"})
+                        return
+                    if self.path == "/readyz":
+                        ready, detail = server._readiness()
+                        self._send(200 if ready else 503, detail)
+                        return
                     self._send(200, server._get(self.path))
+                except QueryError as e:
+                    self._send_query_error(e)
                 except KeyError as e:
                     self._send(404, {"error": str(e)})
                 except Exception as e:
                     self._send(500, {"error": str(e)})
+                finally:
+                    server._leave()
 
             def do_POST(self):
+                server._enter()
                 try:
                     self._send(200, server._post(self.path, self._body()))
+                except QueryError as e:
+                    # taxonomy first: UserError IS a ValueError and
+                    # FallbackError maps to 400 through http_status, so
+                    # the legacy clause below only sees untyped errors
+                    self._send_query_error(e)
                 except (ValueError, KeyError) as e:
                     self._send(400, {"error": str(e)})
                 except Exception as e:
                     self._send(500, {"error": str(e)})
+                finally:
+                    server._leave()
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self.httpd.server_address
@@ -123,8 +181,30 @@ class QueryServer:
         self._thread.start()
         return self
 
-    def stop(self):
-        self.httpd.shutdown()
+    def _enter(self):
+        with self._inflight_cond:
+            self._inflight += 1
+
+    def _leave(self):
+        with self._inflight_cond:
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._inflight_cond.notify_all()
+
+    def stop(self, drain_timeout_s: float = 10.0):
+        """Graceful drain: stop accepting new requests, wait for
+        in-flight handler threads up to `drain_timeout_s`, then
+        force-close. ThreadingHTTPServer handler threads are daemonic,
+        so a bare shutdown()+server_close() could sever a mid-flight
+        device query's response; the drain window lets it finish."""
+        self.httpd.shutdown()  # stop the accept loop (blocks until out)
+        deadline = time.monotonic() + max(0.0, drain_timeout_s)
+        with self._inflight_cond:
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break  # force-close severs the stragglers, by contract
+                self._inflight_cond.wait(min(remaining, 0.1))
         self.httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
@@ -134,6 +214,19 @@ class QueryServer:
         return f"http://{self.host}:{self.port}"
 
     # ----------------------------------------------------------- handlers
+
+    def _readiness(self) -> tuple[bool, dict]:
+        """Readiness probe payload: not ready while the breaker is open
+        (device sick, degraded serving only) or the device is wedged
+        awaiting a reprobe. Liveness (/healthz) stays green either way —
+        the replica is alive, it just should not receive new traffic."""
+        runner = self.engine.runner
+        state = runner.breaker.state
+        wedged = bool(runner._wedged)
+        ready = state != "open" and not wedged
+        return ready, {"ready": ready, "breaker": state,
+                       "wedged": wedged,
+                       "admission": runner.admission.snapshot()}
 
     def _get(self, path: str):
         if path == "/status":
@@ -149,6 +242,11 @@ class QueryServer:
                 } for name, e in ((n, eng.catalog.get(n))
                                   for n in eng.catalog.names())},
                 "counters": eng.counters(),
+                "resilience": {
+                    "breaker": eng.runner.breaker.state,
+                    "wedged": bool(eng.runner._wedged),
+                    "admission": eng.runner.admission.snapshot(),
+                },
             }
         if path.startswith("/status/metadata/"):
             name = path.rsplit("/", 1)[1]

@@ -46,6 +46,8 @@ from tpu_olap.ir.query import (GroupByQuerySpec, TimeseriesQuerySpec,
                                TopNQuerySpec)
 from tpu_olap.kernels.groupby import group_reduce_batch, merge_partials
 from tpu_olap.obs.trace import current_query_id, span as _span
+from tpu_olap.resilience.errors import InternalError
+from tpu_olap.resilience.faults import maybe_inject
 
 AGG_QUERY_TYPES = (TimeseriesQuerySpec, GroupByQuerySpec, TopNQuerySpec)
 
@@ -275,10 +277,15 @@ def _run_fused(runner, table, group, query_ids=None):
         ssp.set(cache_hit=hit, scan_ms_shared=round(shared_ms, 3))
 
         results = []
-        for (q, idxs, plan), m, partials, leg_ms in zip(
-                group, metrics_list, partials_list, agg_ms):
+        for leg_i, ((q, idxs, plan), m, partials, leg_ms) in enumerate(
+                zip(group, metrics_list, partials_list, agg_ms)):
             t0 = time.perf_counter()
             with ssp.span("leg") as lsp:
+                # per-batch-leg fault site (resilience.faults): a leg
+                # failure here boxes the whole group, and every logical
+                # caller falls back per query — testable without a
+                # device fault mid-XLA-program
+                maybe_inject(runner.config, "batch-leg", leg_i)
                 specs = agg_specs_by_name(q.aggregations)
                 keep_raw = theta_raw_fields(q.post_aggregations)
                 arrays = finalize_aggs(partials, plan.agg_plans, specs,
@@ -565,11 +572,12 @@ class Coalescer:
                 by_table.setdefault(id(it.table), []).append(it)
             for items in by_table.values():
                 try:
-                    with self.runner.dispatch_lock:
-                        boxed = run_batch(self.runner,
-                                          [it.query for it in items],
-                                          items[0].table,
-                                          [it.qid for it in items])
+                    # _execute_batch_boxed = admission slot (ONE per
+                    # fused submission, shed -> every caller gets the
+                    # QueryShed) + dispatch_lock + run_batch
+                    boxed = self.runner._execute_batch_boxed(
+                        [it.query for it in items], items[0].table,
+                        [it.qid for it in items])
                 except BaseException as e:  # noqa: BLE001 — fan out
                     boxed = [e] * len(items)
                 for it, b in zip(items, boxed):
@@ -580,7 +588,7 @@ class Coalescer:
         finally:
             for it in batch:
                 if it.result is None and it.error is None:
-                    it.error = RuntimeError(
+                    it.error = InternalError(
                         "batch leader exited without a result")
                 it.event.set()
         if me.error is not None:

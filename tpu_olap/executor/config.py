@@ -180,9 +180,32 @@ class EngineConfig:
     # engine falls back (the analog of the reference's task-kill -> HTTP
     # query abort, SURVEY.md §3.5). None = no deadline.
     query_deadline_s: float | None = None
-    # test hook: callable(stage: str, attempt: int) -> None, may raise to
-    # inject a dispatch fault (None in production)
+    # fault hook: callable(stage: str, attempt: int) -> None, may raise
+    # to inject a fault (None in production). A plain callable fires only
+    # at the classic "dispatch" site; declaring a `stages` attribute
+    # (None = all) opts into the generalized sites — host-transfer,
+    # reprobe, ingest, batch-leg (resilience.faults.maybe_inject).
     fault_injector: object = None
+
+    # --- resilience layer (tpu_olap.resilience; docs/RESILIENCE.md) ---
+    # admission control: a bounded device-dispatch queue in front of
+    # dispatch_lock. At most max_inflight_dispatches hold slots at once;
+    # at most admission_queue_limit wait for one; the next caller (or a
+    # caller whose query_deadline_s budget cannot cover the expected
+    # queue wait) is shed immediately with QueryShed -> HTTP 429,
+    # instead of piling onto the lock and timing out later.
+    # max_inflight_dispatches <= 0 disables admission entirely.
+    max_inflight_dispatches: int = 8
+    admission_queue_limit: int = 64
+    # circuit breaker: this many CONSECUTIVE terminal device failures
+    # (dispatch retries exhausted, deadline hits, probe failures) trip
+    # it open; while open, fallback-capable queries serve from the
+    # interpreter (path="fallback_breaker") and the rest refuse with
+    # BreakerOpen -> HTTP 503 + Retry-After. A background healer thread
+    # probes the device every breaker_open_cooldown_s and closes the
+    # breaker when the probe succeeds. <= 0 disables the breaker.
+    breaker_failure_threshold: int = 5
+    breaker_open_cooldown_s: float = 5.0
 
     # tracing (SURVEY.md §6): when set, each query dispatch runs under a
     # jax.profiler trace written beneath this directory; the history record

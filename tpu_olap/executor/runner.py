@@ -20,6 +20,10 @@ from tpu_olap.executor.dataset import DeviceDataset
 from tpu_olap.obs.metrics import MetricsRegistry
 from tpu_olap.obs.trace import (Tracer, current_query_id, short_str,
                                 span as _span)
+from tpu_olap.resilience.admission import AdmissionController
+from tpu_olap.resilience.breaker import CircuitBreaker
+from tpu_olap.resilience.errors import QueryError
+from tpu_olap.resilience.faults import maybe_inject
 from tpu_olap.executor.lowering import PhysicalPlan, lower
 from tpu_olap.executor.packing import (build_packer, densify, make_layout,
                                        unpack)
@@ -48,12 +52,17 @@ class QueryResult:
         return pd.DataFrame(self.rows)
 
 
-class QueryDeadlineExceeded(Exception):
+class QueryDeadlineExceeded(QueryError):
     """Raised when a query exceeds EngineConfig.query_deadline_s. The
     in-process analog of the reference's task-kill -> HTTP query abort
     (SURVEY.md §3.5): the caller falls back; the abandoned dispatch thread
     finishes (and is discarded) in the background since an in-flight XLA
-    computation cannot be interrupted."""
+    computation cannot be interrupted. Part of the resilience error
+    taxonomy: HTTP surfaces map it to 504 when no fallback answered."""
+
+    code = "deadline_exceeded"
+    retriable = True
+    http_status = 504
 
 
 class HistoryRing(list):
@@ -188,11 +197,39 @@ class QueryRunner:
         self._m_batch = m.histogram(
             "batch_size", "Logical queries per shared-scan batch.",
             buckets=(1, 2, 4, 8, 16, 32, 64))
+        self._m_degraded = m.counter(
+            "degraded_queries_total",
+            "Queries served by the interpreter while the breaker was "
+            "open (path=fallback_breaker).")
+        # resilience layer (tpu_olap.resilience; docs/RESILIENCE.md):
+        # bounded admission in front of dispatch_lock, plus the device
+        # circuit breaker whose healer probes via _healer_probe
+        self.admission = AdmissionController(
+            self.config.max_inflight_dispatches,
+            self.config.admission_queue_limit, metrics=m)
+        self.breaker = CircuitBreaker(
+            self.config.breaker_failure_threshold,
+            self.config.breaker_open_cooldown_s,
+            probe=self._healer_probe, metrics=m)
+        self._attempt_local = threading.local()  # host-transfer inject
+
+    def _inject(self, stage: str):
+        """Generalized fault-injection hook (resilience.faults): fires
+        the configured injector at `stage` with the current dispatch
+        attempt (thread-local, set by _dispatch), so a fault at e.g.
+        host-transfer rides the same retry accounting as a dispatch
+        fault."""
+        maybe_inject(self.config, stage,
+                     getattr(self._attempt_local, "value", 0))
 
     def _metric_path(self, m: dict) -> str:
         """Dashboard path label: which execution flavor served this
         record (docs/OBSERVABILITY.md)."""
         if m.get("query_type") == "fallback" or m.get("fallback"):
+            # degraded-but-correct serving while the breaker is open is
+            # its own first-class path (docs/RESILIENCE.md)
+            if m.get("fallback_breaker"):
+                return "fallback_breaker"
             return "fallback"
         if m.get("batch_dedup") or m.get("batch_legs", 0) > 1:
             return "batch"
@@ -243,6 +280,8 @@ class QueryRunner:
             self._m_retries.inc(m["retries"])
         if m.get("deadline_exceeded"):
             self._m_deadline.inc()
+        if m.get("fallback_breaker"):
+            self._m_degraded.inc()
         if "hbm_bytes" in m:
             self._m_hbm_bytes.set(m["hbm_bytes"])
         if "hbm_evictions" in m:
@@ -279,9 +318,12 @@ class QueryRunner:
         attempts = max(1, self.config.dispatch_retries + 1)
         for attempt in range(attempts):
             try:
-                if self.config.fault_injector is not None:
-                    self.config.fault_injector("dispatch", attempt)
-                return call()
+                maybe_inject(self.config, "dispatch", attempt)
+                self._attempt_local.value = attempt
+                out = call()
+                # success resets the breaker's consecutive-failure count
+                self.breaker.record_success()
+                return out
             except UnsupportedAggregation:
                 raise  # structural, not transient: straight to fallback
             except Exception as e:
@@ -290,6 +332,10 @@ class QueryRunner:
                 metrics.setdefault("retry_errors", []).append(
                     f"{type(e).__name__}: {e}")
                 if attempt + 1 >= attempts:
+                    # terminal (retries exhausted): one breaker failure —
+                    # per-attempt errors the retry layer absorbed are not
+                    # breaker events
+                    self.breaker.record_failure()
                     raise
                 metrics["retries"] = attempt + 1
                 if self.config.degrade_shards_on_retry and \
@@ -330,8 +376,11 @@ class QueryRunner:
 
     def _execute_batch_boxed(self, queries, table, query_ids=None) -> list:
         from tpu_olap.executor.batch import run_batch
-        with self.dispatch_lock:
-            return run_batch(self, queries, table, query_ids)
+        # one admission slot per batch submission: the fused dispatch is
+        # one device occupancy however many logical queries ride it
+        with self.admission.slot(self.config.query_deadline_s):
+            with self.dispatch_lock:
+                return run_batch(self, queries, table, query_ids)
 
     def _next_batch_id(self) -> int:
         self._batch_seq += 1
@@ -345,6 +394,7 @@ class QueryRunner:
         and a wedged device is reprobed before being trusted again. The
         batch executor's fused pass uses this so coalesced callers are
         never hung past the deadline the single-query path honors."""
+        self.breaker.check()
         deadline = self.config.query_deadline_s
         if deadline is None:
             return self._dispatch(call, metrics, table_name)
@@ -356,20 +406,27 @@ class QueryRunner:
             name="tpu-olap-batch-dispatch")
 
     def execute(self, query, table) -> QueryResult:
+        # breaker first: while open, fail in microseconds (the engine
+        # routes fallback-capable queries to the interpreter) instead of
+        # queueing doomed work onto the sick device
+        self.breaker.check()
         if self._coalescer is not None:
             from tpu_olap.executor.batch import AGG_QUERY_TYPES
             if isinstance(query, AGG_QUERY_TYPES):
                 # waits OUTSIDE dispatch_lock so concurrent callers can
                 # coalesce; the batch leader takes the lock to dispatch
+                # (and holds the one admission slot for the batch)
                 with _span("coalesce") as sp:
                     res = self._coalescer.submit(query, table)
                     sp.set(batch_id=res.metrics.get("batch_id"),
                            batch_size=res.metrics.get("batch_size"))
                 return res
-        with self.dispatch_lock:
-            return self._execute_locked(query, table)
+        with self.admission.slot(self.config.query_deadline_s):
+            with self.dispatch_lock:
+                return self._execute_locked(query, table)
 
     def _execute_locked(self, query, table) -> QueryResult:
+        self.breaker.check()
         deadline = self.config.query_deadline_s
         if deadline is not None:
             if self._wedged:
@@ -431,6 +488,7 @@ class QueryRunner:
             if on_timeout is not None:
                 on_timeout()
             self._wedged = True
+            self.breaker.record_failure("deadline")
             self.record({**rec, "deadline_exceeded": True,
                          "total_ms": deadline * 1000})
             raise QueryDeadlineExceeded(
@@ -439,16 +497,18 @@ class QueryRunner:
             raise box["err"]
         return box["res"]
 
-    def _reprobe_device(self, deadline: float):
-        """Post-wedge health check: a trivial device round-trip under the
-        deadline. Success clears the wedge and purges device caches (the
-        hang may have been a device reset poisoning buffers); failure
-        raises immediately."""
+    def _probe_device(self, timeout: float) -> bool:
+        """Trivial device round-trip on a watchdog thread; True iff it
+        completes within `timeout`. The one probe primitive shared by
+        the post-wedge reprobe and the breaker's healer thread. The
+        "reprobe" fault-injection site lives here, so probe failure is
+        testable without a real sick device."""
         import threading
         ok = threading.Event()
 
         def work():
             try:
+                maybe_inject(self.config, "reprobe", 0)
                 if self.config.platform != "cpu":
                     import jax.numpy as jnp
                     jnp.ones((8,), jnp.int32).sum().block_until_ready()
@@ -459,21 +519,50 @@ class QueryRunner:
         t = threading.Thread(target=work, daemon=True,
                              name="tpu-olap-probe")
         t.start()
-        t.join(deadline)
-        if not ok.is_set():
-            self.record({"device_probe_failed": True})
-            raise QueryDeadlineExceeded(
-                "device still unresponsive after a deadline-expired query")
+        t.join(timeout)
+        return ok.is_set()
+
+    def _recover_after_probe(self):
+        """Probe succeeded: clear the wedge and purge device-resident
+        DATA (buffers a reset would poison) but keep compiled
+        executables — recompiling every template would eat the next
+        query's deadline; if an executable is also poisoned, the
+        _dispatch retry layer purges the table's full cache anyway."""
         self._wedged = False
-        # purge device-resident DATA (buffers a reset would poison) but
-        # keep compiled executables — recompiling every template would eat
-        # the next query's deadline; if an executable is also poisoned,
-        # the _dispatch retry layer purges the table's full cache anyway
         for ds in list(self._datasets.values()):
             ds.evict()
         self._datasets.clear()
         self._arg_cache.clear()
         self.record({"device_probe_recovered": True})
+
+    def _reprobe_device(self, deadline: float):
+        """Post-wedge health check: a trivial device round-trip under the
+        deadline. Success clears the wedge and purges device caches (the
+        hang may have been a device reset poisoning buffers); failure
+        raises immediately."""
+        if not self._probe_device(deadline):
+            self.record({"device_probe_failed": True})
+            self.breaker.record_failure("probe")
+            raise QueryDeadlineExceeded(
+                "device still unresponsive after a deadline-expired query")
+        self._recover_after_probe()
+
+    def _healer_probe(self) -> bool:
+        """The breaker healer's half-open probe (resilience.breaker):
+        same round-trip; success also clears the wedge and purges
+        device-resident data so the first post-recovery query starts
+        from trustworthy buffers."""
+        timeout = self.config.query_deadline_s or 10.0
+        if not self._probe_device(timeout):
+            self.record({"device_probe_failed": True})
+            return False
+        # under dispatch_lock: a query that slipped through during
+        # half-open may be mid-dispatch on these datasets — the reprobe
+        # path gets this for free (it runs inside _execute_locked), the
+        # healer thread must take it explicitly
+        with self.dispatch_lock:
+            self._recover_after_probe()
+        return True
 
     def _execute(self, query, table, abandoned=None) -> QueryResult:
         t0 = time.perf_counter()
@@ -829,6 +918,7 @@ class QueryRunner:
         with _span("host-transfer"):
             # jax dispatch is async: materializing to numpy is where the
             # device round-trip actually blocks
+            self._inject("host-transfer")
             out = {k: np.asarray(v) for k, v in out.items()}
         metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
         metrics["cache_hit"] = hit
@@ -929,6 +1019,7 @@ class QueryRunner:
                     if win is not None else \
                     jitted(env, valid, seg_arg, consts_dev)
                 with _span("host-transfer"):
+                    self._inject("host-transfer")
                     count, idx, compact = unpack(buf, layout)
                 if count <= layout.cap:
                     break

@@ -29,6 +29,16 @@ import threading
 LATENCY_BUCKETS_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
                       500.0, 1000.0, 2500.0, 5000.0, 10000.0, 30000.0)
 
+# admission queue-wait buckets (ms): most admitted queries wait 0 or a
+# few ms; the tail matters up to roughly one deadline (past that the
+# controller sheds instead of queueing — resilience.admission)
+QUEUE_WAIT_BUCKETS_MS = (0.1, 0.5, 1.0, 5.0, 10.0, 25.0, 50.0, 100.0,
+                         250.0, 500.0, 1000.0, 5000.0)
+
+# breaker-state gauge encoding (resilience.breaker exports the live
+# mapping; duplicated here so dashboards can reference one module)
+BREAKER_STATE_VALUES = {"closed": 0, "half_open": 1, "open": 2}
+
 _NAME_OK = "abcdefghijklmnopqrstuvwxyz" \
            "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_:"
 

@@ -23,6 +23,7 @@ from tpu_olap.planner.exprutil import (contains_agg as _contains_agg,
                                        render as _auto_name,
                                        split_and as _split_and)
 from tpu_olap.planner.sqlparse import (AGG_FUNCS, SelectStmt, UnionStmt)
+from tpu_olap.resilience.errors import QueryError
 from tpu_olap.segments.dictionary import _like_to_regex
 
 _TIME_FUNCS = {"year", "month", "day", "dayofmonth", "quarter",
@@ -31,8 +32,15 @@ _THETA_SET_FNS = {"theta_sketch_intersect", "theta_sketch_union",
                   "theta_sketch_not"}
 
 
-class FallbackError(Exception):
-    pass
+class FallbackError(QueryError):
+    """The interpreter cannot serve this statement either (unsupported
+    SQL shape, or a refused-at-scale result). The request itself is the
+    problem, so the HTTP surface maps it to 400 — distinguishable from
+    transient 429/503/504 resilience errors."""
+
+    code = "unsupported_sql"
+    retriable = False
+    http_status = 400
 
 
 def _run_inner_stmt(s, catalog, config) -> pd.DataFrame:

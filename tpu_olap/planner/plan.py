@@ -78,6 +78,10 @@ class PlanResult:
     outputs: list = field(default_factory=list)
     fallback_reason: str | None = None
     sql: str | None = None
+    # set when the device circuit breaker (resilience.breaker) rerouted
+    # this statement to the interpreter: the record stamps
+    # path="fallback_breaker" so degraded serving is visible
+    breaker_fallback: bool = False
 
     @property
     def rewritten(self) -> bool:

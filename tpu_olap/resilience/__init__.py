@@ -1,0 +1,32 @@
+"""Resilience layer: structured errors, admission control, circuit
+breaker, and generalized fault injection (docs/RESILIENCE.md).
+
+The reference survives broker flakiness because Spark task retry re-runs
+a DruidRDD partition and the planner can always fall back to the raw
+scan (SURVEY.md §2 property 2, §6). This package adds what happens
+*around* a sick device under heavy concurrent traffic:
+
+- errors:    the QueryError taxonomy (code / retriable / http_status)
+             that the HTTP surface maps to 400 / 429 / 503 / 504
+- admission: bounded device-dispatch queue (max inflight + max queued,
+             deadline-aware shedding)
+- breaker:   circuit breaker on consecutive device failures, with a
+             background healer thread that half-opens via the device
+             probe and routes fallback-capable queries to the
+             interpreter while open (path="fallback_breaker")
+- faults:    the generalized EngineConfig.fault_injector call sites
+             (dispatch / host-transfer / reprobe / ingest / batch-leg)
+"""
+
+from tpu_olap.resilience.admission import AdmissionController
+from tpu_olap.resilience.breaker import CircuitBreaker
+from tpu_olap.resilience.errors import (BreakerOpen, DeviceFailure,
+                                        InternalError, QueryError,
+                                        QueryShed, UserError)
+from tpu_olap.resilience.faults import FaultInjector, maybe_inject
+
+__all__ = [
+    "AdmissionController", "BreakerOpen", "CircuitBreaker",
+    "DeviceFailure", "FaultInjector", "InternalError", "QueryError",
+    "QueryShed", "UserError", "maybe_inject",
+]

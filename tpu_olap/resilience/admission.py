@@ -1,0 +1,171 @@
+"""Admission control: a bounded device-dispatch queue.
+
+The chip has one program queue (SURVEY.md §3.5 P1), so device dispatch
+serializes on QueryRunner.dispatch_lock — but the HTTP surface runs on
+an unbounded ThreadingHTTPServer thread pool, and before this layer
+every concurrent query piled onto that lock and waited however long the
+backlog took. The admission controller bounds that pile-up the way a
+production broker does:
+
+- at most `max_inflight` dispatches hold slots concurrently (the lock
+  still serializes the device itself; extra slots overlap the Python
+  pre/post work around it);
+- at most `queue_limit` callers wait for a slot — the next one is shed
+  immediately with QueryShed (HTTP 429), which a load balancer turns
+  into "try another replica" instead of a growing queue;
+- **deadline-aware shedding**: a query whose `query_deadline_s` budget
+  cannot cover the expected queue wait (EWMA of recent slot hold times
+  x queue depth) is shed at the door instead of burning its deadline in
+  line and timing out anyway — the difference between a 429 in
+  microseconds and a 504 after `query_deadline_s`.
+
+Queue depth, queue wait, and shed counts are first-class metrics
+(`tpu_olap_admission_queue_depth`, `tpu_olap_admission_queue_wait_ms`,
+`tpu_olap_queries_shed_total{reason=...}`).
+
+Slot acquisition is not strictly FIFO (condition wake order, and a
+fresh arrival can take a just-freed slot before a woken waiter) — the
+bound is on *how many* wait, not their order; all waiters make progress
+because every release notifies.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+from tpu_olap.resilience.errors import QueryShed
+
+# seed for the service-time EWMA before any dispatch completes; a few
+# tens of ms is the observed warm SSB dispatch scale
+_EWMA_SEED_S = 0.05
+_EWMA_ALPHA = 0.2
+
+
+class AdmissionController:
+    """Bounded, deadline-aware admission to the dispatch section.
+
+    `max_inflight <= 0` disables admission entirely (every slot()
+    context is a no-op) — the pre-resilience behavior.
+    """
+
+    def __init__(self, max_inflight: int, queue_limit: int,
+                 metrics=None):
+        self.max_inflight = int(max_inflight)
+        self.queue_limit = max(0, int(queue_limit))
+        self._cond = threading.Condition()
+        self._inflight = 0
+        self._queued = 0
+        self._service_ewma_s = _EWMA_SEED_S
+        self._local = threading.local()  # re-entrancy guard
+        self._m_shed = self._m_depth = self._m_wait = None
+        if metrics is not None:
+            from tpu_olap.obs.metrics import QUEUE_WAIT_BUCKETS_MS
+            self._m_shed = metrics.counter(
+                "queries_shed_total",
+                "Queries shed by admission control.", ("reason",))
+            self._m_depth = metrics.gauge(
+                "admission_queue_depth",
+                "Callers currently queued for a dispatch slot.")
+            self._m_wait = metrics.histogram(
+                "admission_queue_wait_ms",
+                "Wait for a dispatch slot (admitted queries only).",
+                buckets=QUEUE_WAIT_BUCKETS_MS)
+            self._m_depth.set(0)
+
+    # ------------------------------------------------------------ stats
+
+    def snapshot(self) -> dict:
+        with self._cond:
+            return {"inflight": self._inflight, "queued": self._queued,
+                    "max_inflight": self.max_inflight,
+                    "queue_limit": self.queue_limit,
+                    "service_ewma_ms": round(
+                        self._service_ewma_s * 1000, 3)}
+
+    def _expected_wait_s(self) -> float:
+        """Coarse queue-wait estimate under the lock: everyone ahead of
+        a new arrival (current queue, plus the backlog implied by full
+        slots) costs ~one EWMA'd service time per max_inflight slots."""
+        if self._inflight < self.max_inflight:
+            return 0.0
+        ahead = self._queued + 1
+        return ahead * self._service_ewma_s / max(1, self.max_inflight)
+
+    def _shed(self, reason: str, msg: str):
+        if self._m_shed is not None:
+            self._m_shed.inc(reason=reason)
+        raise QueryShed(msg, reason=reason)
+
+    # ------------------------------------------------------------- slot
+
+    @contextmanager
+    def slot(self, budget_s: float | None = None):
+        """Hold one dispatch slot for the body. May raise QueryShed
+        before the body runs; never after. `budget_s` is the query's
+        remaining deadline budget (None = no deadline): used both for
+        the at-the-door expected-wait shed and as the cap on actual
+        queue wait. Re-entrant per thread (nested holds are free), so
+        a batch path that re-enters the runner never deadlocks on its
+        own slot."""
+        if self.max_inflight <= 0 or getattr(self._local, "held", 0):
+            yield
+            return
+        waited_ms = self._admit(budget_s)
+        if self._m_wait is not None:
+            self._m_wait.observe(waited_ms)
+        self._local.held = 1
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._local.held = 0
+            held_s = time.perf_counter() - t0
+            with self._cond:
+                self._inflight -= 1
+                self._service_ewma_s += _EWMA_ALPHA * (
+                    held_s - self._service_ewma_s)
+                self._cond.notify()
+
+    def _admit(self, budget_s: float | None) -> float:
+        """Block until a slot frees (bounded by queue_limit and the
+        deadline budget); returns the wait in ms."""
+        with self._cond:
+            if self._inflight < self.max_inflight:
+                self._inflight += 1
+                return 0.0
+            if self._queued >= self.queue_limit:
+                self._shed(
+                    "queue_full",
+                    f"dispatch queue full ({self._queued} queued, "
+                    f"limit {self.queue_limit})")
+            exp = self._expected_wait_s()
+            if budget_s is not None and exp > budget_s:
+                self._shed(
+                    "deadline_budget",
+                    f"expected queue wait {exp * 1000:.0f} ms exceeds "
+                    f"the query's deadline budget "
+                    f"{budget_s * 1000:.0f} ms")
+            self._queued += 1
+            if self._m_depth is not None:
+                self._m_depth.set(self._queued)
+            t0 = time.perf_counter()
+            deadline = None if budget_s is None else t0 + budget_s
+            try:
+                while self._inflight >= self.max_inflight:
+                    timeout = None
+                    if deadline is not None:
+                        timeout = deadline - time.perf_counter()
+                        if timeout <= 0:
+                            self._shed(
+                                "deadline_budget",
+                                "deadline budget exhausted while "
+                                "queued for a dispatch slot")
+                    self._cond.wait(timeout)
+            finally:
+                self._queued -= 1
+                if self._m_depth is not None:
+                    self._m_depth.set(self._queued)
+            self._inflight += 1
+            return (time.perf_counter() - t0) * 1000

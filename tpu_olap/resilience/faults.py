@@ -1,0 +1,72 @@
+"""Generalized fault injection (EngineConfig.fault_injector).
+
+The injector signature is unchanged — ``callable(stage, attempt)`` that
+may raise — but the call sites now cover every failure point the
+resilience layer must survive, not just the device dispatch:
+
+    dispatch       executor.runner._dispatch (per retry attempt)
+    host-transfer  device buffers -> numpy materialization
+    reprobe        the post-wedge / healer device probe
+    ingest         Engine.register_table's segment build
+    batch-leg      per-leg finalize of a fused shared-scan dispatch
+
+Backwards compatibility: a plain callable (no ``stages`` attribute)
+fires ONLY at the classic ``dispatch`` site, exactly as before — every
+pre-existing test and tool keeps its behavior. An injector that wants
+the generalized sites declares them:
+
+    class Chaos:
+        stages = None            # None = every site
+        # or stages = {"dispatch", "host-transfer"}
+        def __call__(self, stage, attempt): ...
+
+or uses the FaultInjector helper below.
+"""
+
+from __future__ import annotations
+
+LEGACY_STAGES = ("dispatch",)
+
+ALL_STAGES = ("dispatch", "host-transfer", "reprobe", "ingest",
+              "batch-leg")
+
+
+def maybe_inject(config, stage: str, attempt: int = 0) -> None:
+    """Fire the configured fault injector at `stage` if it opted in.
+    Injectors without a `stages` attribute are legacy dispatch-only."""
+    inj = getattr(config, "fault_injector", None)
+    if inj is None:
+        return
+    stages = getattr(inj, "stages", LEGACY_STAGES)
+    if stages is not None and stage not in stages:
+        return
+    inj(stage, attempt)
+
+
+class FaultInjector:
+    """Deterministic seeded chaos injector for tests and bench runs:
+    raises RuntimeError at each opted-in site with probability `rate`
+    (or on an explicit schedule via `fail_calls`). `stages=None` opts
+    into every site."""
+
+    def __init__(self, seed: int = 0, rate: float = 0.0, stages=None,
+                 fail_calls=()):
+        import random
+        self.rng = random.Random(seed)
+        self.rate = float(rate)
+        self.stages = stages
+        self.fail_calls = set(fail_calls)
+        self.calls = 0
+        self.faults = 0
+        self.by_stage: dict[str, int] = {}
+
+    def __call__(self, stage: str, attempt: int):
+        self.calls += 1
+        hit = self.calls in self.fail_calls or (
+            self.rate > 0 and self.rng.random() < self.rate)
+        if hit:
+            self.faults += 1
+            self.by_stage[stage] = self.by_stage.get(stage, 0) + 1
+            raise RuntimeError(
+                f"injected fault #{self.faults} at {stage} "
+                f"(call {self.calls}, attempt {attempt})")
