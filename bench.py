@@ -244,7 +244,8 @@ def _fault_overhead(eng, iters: int, note):
     return fault_ms, fell_back
 
 
-def main(span_summary: bool = False, inject_faults: int | None = None):
+def main(span_summary: bool = False, inject_faults: int | None = None,
+         trace_out: str | None = None):
     eng, ctx = _setup()
     note = ctx["note"]
     backend, rows, iters = ctx["backend"], ctx["rows"], ctx["iters"]
@@ -288,6 +289,9 @@ def main(span_summary: bool = False, inject_faults: int | None = None):
     phase_ms = {}  # --span-summary: per-query per-phase p50 from the
     #                span tree (obs.trace) — parse/plan/prepare/dispatch/
     #                host-transfer/assemble attribution in the artifact
+    slow_traces = {}  # --trace-out: (ms, Trace) of each query's slowest
+    #                   timed iteration, exported as one Chrome trace so
+    #                   profiles get banked alongside the numbers
     for qname in sorted(QUERIES):
         sql = QUERIES[qname]
         # Warm twice: the first run compiles and observes the true group
@@ -309,6 +313,10 @@ def main(span_summary: bool = False, inject_faults: int | None = None):
             t0 = time.perf_counter()
             eng.sql(sql)
             times.append((time.perf_counter() - t0) * 1000)
+            if trace_out is not None and eng.tracer.last is not None:
+                prev = slow_traces.get(qname)
+                if prev is None or times[-1] > prev[0]:
+                    slow_traces[qname] = (times[-1], eng.tracer.last)
             # only records THIS dispatch appended: a fallback-served
             # iteration must not re-report a stale device timing
             fresh = [m for m in eng.history[n0:] if "execute_ms" in m]
@@ -332,6 +340,16 @@ def main(span_summary: bool = False, inject_faults: int | None = None):
         note(f"{qname} p50={detail[qname]}ms "
              f"[{spread[qname]['min']}..{spread[qname]['max']}] "
              f"exec={exec_ms.get(qname)}ms")
+
+    if trace_out is not None:
+        # one Chrome-trace file with each flight's slowest query as its
+        # own named row — open in Perfetto next to the BENCH json
+        from tpu_olap.obs.profile import chrome_trace
+        traces = [slow_traces[q][1] for q in sorted(slow_traces)]
+        with open(trace_out, "w") as f:
+            json.dump(chrome_trace(traces), f)
+        note(f"chrome trace written: {trace_out} "
+             f"({len(traces)} slowest-iteration traces)")
 
     fault_detail = None
     if inject_faults:
@@ -377,6 +395,7 @@ def main(span_summary: bool = False, inject_faults: int | None = None):
                     "evictions": ledger.evictions},
             **({"per_query_phase_p50_ms": phase_ms}
                if span_summary else {}),
+            **({"trace_out": trace_out} if trace_out else {}),
             **({"fault_injection": fault_detail}
                if fault_detail else {}),
             **({"result_digests": digests} if want_digest else {}),
@@ -534,6 +553,12 @@ def _parse_args(argv=None):
              "obs.trace span tree) into the BENCH json detail as "
              "per_query_phase_p50_ms")
     p.add_argument(
+        "--trace-out", type=str, default=None, metavar="PATH",
+        help="write a Chrome-trace JSON (loads in Perfetto) of each "
+             "SSB query's slowest timed iteration to PATH, so per-run "
+             "profiles are banked next to the BENCH json "
+             "(docs/OBSERVABILITY.md)")
+    p.add_argument(
         "--inject-faults", type=int, nargs="?", const=3, default=None,
         metavar="N",
         help="after the clean timed runs, re-time each SSB query N "
@@ -541,11 +566,16 @@ def _parse_args(argv=None):
              "execution; banks per-query faulted p50 and the recovery "
              "overhead (faulted minus clean) into the BENCH json "
              "detail as fault_injection (docs/RESILIENCE.md)")
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if args.concurrency is not None and args.trace_out:
+        p.error("--trace-out only applies to the latency bench; it is "
+                "not written by the --concurrency throughput A/B")
+    return args
 
 
 if __name__ == "__main__":
     args = _parse_args()
     if args.concurrency is not None:
         sys.exit(_concurrency_main(args.concurrency))
-    main(span_summary=args.span_summary, inject_faults=args.inject_faults)
+    main(span_summary=args.span_summary, inject_faults=args.inject_faults,
+         trace_out=args.trace_out)

@@ -339,6 +339,7 @@ def test_bench_help_advertises_span_summary():
     assert proc.returncode == 0
     assert "--span-summary" in proc.stdout
     assert "--concurrency" in proc.stdout
+    assert "--trace-out" in proc.stdout
 
 
 def test_ssb_explain_analyze_sums():

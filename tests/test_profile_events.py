@@ -1,0 +1,460 @@
+"""Device-facing observability (ISSUE 8): the Chrome-trace exporter +
+/debug/profile, the structured event log + /debug/events, memory/compile
+accounting in /metrics, the SLO burn rate, and tools/bench_compare.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpu_olap import Engine
+from tpu_olap.api.server import QueryServer
+from tpu_olap.executor import EngineConfig
+from tpu_olap.obs.events import EventLog
+from tpu_olap.obs.profile import chrome_trace
+from tpu_olap.obs.slo import SloTracker
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _df(n=6000, seed=11):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "ts": pd.to_datetime("2023-03-01")
+        + pd.to_timedelta(rng.integers(0, 86400 * 90, n), unit="s"),
+        "g": rng.choice([f"g{i}" for i in range(12)], n),
+        "h": rng.choice([f"h{i}" for i in range(7)], n),
+        "v": rng.integers(0, 1000, n).astype(np.int64),
+    })
+
+
+def _engine(**kw):
+    eng = Engine(EngineConfig(**kw))
+    eng.register_table("t", _df(), time_column="ts", block_rows=1 << 11)
+    return eng
+
+
+GROUP_SQL = "SELECT g, sum(v) AS s FROM t GROUP BY g ORDER BY g"
+GROUP2_SQL = "SELECT h, sum(v) AS s2 FROM t GROUP BY h ORDER BY h"
+AGG_SQL = "SELECT sum(v) AS s, count(*) AS n FROM t"
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return r.status, r.read().decode()
+
+
+def _get_code(url):
+    try:
+        with urllib.request.urlopen(url, timeout=30) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _post(url, code_only=False):
+    req = urllib.request.Request(url, data=b"", method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# ------------------------------------------------------- span positions
+
+
+def test_span_start_ms_stamped_and_contained():
+    """Satellite: spans carry start_ms (offset from the trace root) so
+    timelines are layout-able; children sit inside their parents."""
+    eng = _engine()
+    eng.sql(GROUP_SQL)
+    trace = eng.tracer.last
+    assert trace.start_ms == 0.0
+    seen = 0
+    for _, s in trace.walk():
+        if s.start_ms is None or s.duration_ms is None:
+            continue
+        end = s.start_ms + s.duration_ms
+        for c in s.children:
+            if c.start_ms is None or c.duration_ms is None:
+                continue
+            seen += 1
+            assert c.start_ms >= s.start_ms - 0.001
+            assert c.start_ms + c.duration_ms <= end + 0.5
+    assert seen >= 4  # parse/plan/execute/dispatch at least
+    j = trace.to_json()
+    assert j["start_ms"] == 0.0
+    assert j["children"][0]["start_ms"] >= 0.0
+
+
+# ------------------------------------------------------- chrome export
+
+
+def _x_events(doc):
+    return [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+
+
+def test_chrome_trace_schema_and_roundtrip():
+    """Tentpole acceptance: every complete event has ts/dur/pid/tid/
+    name, the JSON round-trips, and per-trace events sit inside their
+    root's interval (the Perfetto layout contract)."""
+    eng = _engine()
+    eng.sql(GROUP_SQL)
+    eng.sql(AGG_SQL)
+    doc = json.loads(json.dumps(
+        chrome_trace(eng.tracer.recent_traces())))
+    assert doc["traceEvents"][0]["args"]["name"] == "tpu_olap"
+    xs = _x_events(doc)
+    assert len(xs) >= 10
+    by_tid = {}
+    for e in xs:
+        for k in ("name", "ts", "dur", "pid", "tid"):
+            assert k in e, f"event missing {k}: {e}"
+        assert e["dur"] >= 0 and e["ts"] > 0
+        by_tid.setdefault(e["tid"], []).append(e)
+    assert len(by_tid) == 2  # one tid per query
+    for tid, evs in by_tid.items():
+        root = next(e for e in evs if e["name"] in ("sql", "sql_batch"))
+        assert root["args"]["query_id"].startswith("q")
+        lo, hi = root["ts"], root["ts"] + root["dur"]
+        for e in evs:
+            assert e["ts"] >= lo - 1.0          # µs tolerance
+            assert e["ts"] + e["dur"] <= hi + 500.0
+    # thread_name metadata names each query row
+    metas = [e for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "thread_name"]
+    assert len(metas) == 2
+    assert all(m["args"]["name"].startswith("query q") for m in metas)
+
+
+def test_chrome_trace_batch_legs_share_shared_scan_tid():
+    eng = _engine()
+    eng.sql_batch([GROUP_SQL, GROUP2_SQL])
+    trace = eng.tracer.last
+    assert trace.name == "sql_batch"
+    doc = chrome_trace([trace])
+    xs = _x_events(doc)
+    shared = [e for e in xs if e["name"] == "shared-scan"]
+    legs = [e for e in xs if e["name"] == "leg"]
+    assert shared and len(legs) == 2
+    tid = shared[0]["tid"]
+    for leg in legs:
+        assert leg["tid"] == tid
+        # and the leg sits inside the shared-scan interval
+        assert leg["ts"] >= shared[0]["ts"] - 1.0
+        assert leg["ts"] + leg["dur"] \
+            <= shared[0]["ts"] + shared[0]["dur"] + 500.0
+
+
+def test_debug_profile_endpoints():
+    """GET /debug/profile serves Chrome-trace JSON; POST runs (or
+    legibly degrades) a jax.profiler capture; params are validated."""
+    eng = _engine()
+    eng.sql(GROUP_SQL)
+    eng.sql(AGG_SQL)
+    srv = QueryServer(eng).start()
+    try:
+        _, body = _get(srv.url + "/debug/profile")
+        doc = json.loads(body)
+        assert _x_events(doc)
+        _, body1 = _get(srv.url + "/debug/profile?n=1")
+        assert len(_x_events(json.loads(body1))) < len(_x_events(doc))
+        code, _ = _get_code(srv.url + "/debug/profile?n=oops")
+        assert code == 400
+        # on-demand capture: ok on backends with a working profiler,
+        # a structured degrade elsewhere — never a 500
+        code, out = _post(srv.url + "/debug/profile?ms=20")
+        assert code == 200 and "ok" in out
+        if out["ok"]:
+            assert os.path.isdir(out["trace_dir"])
+        else:
+            assert out["reason"]
+        code, _ = _post(srv.url + "/debug/profile?ms=nope")
+        assert code == 400
+    finally:
+        srv.stop()
+
+
+# ----------------------------------------------------------- event log
+
+
+def test_events_contract_every_path():
+    """One structured event per query on every serving path — dense,
+    sparse, fallback, batch leg (incl. dedup fan-out), and shed."""
+    eng = _engine(max_inflight_dispatches=1, admission_queue_limit=0)
+    eng.register_table("dim", pd.DataFrame({"k": [1, 2]}),
+                       accelerate=False)
+
+    def q_events():
+        return [e for e in eng.runner.events.snapshot()
+                if e["event"] == "query"]
+
+    n0 = len(q_events())
+    eng.sql(GROUP_SQL)                    # dense
+    assert len(q_events()) == n0 + 1
+    assert q_events()[0]["path"] == "dense"
+    eng.sql("SELECT k FROM dim")          # fallback
+    assert q_events()[0]["path"] == "fallback"
+    outs = eng.sql_batch([GROUP_SQL, GROUP2_SQL, GROUP_SQL])
+    assert len(outs) == 3
+    batch_evs = [e for e in q_events() if e["path"] == "batch"]
+    assert len(batch_evs) == 3            # 2 legs + 1 dedup fan-out
+    assert len({e["query_id"] for e in batch_evs}) == 3
+
+    sp = Engine(EngineConfig(dense_group_budget=4))
+    sp.register_table("t", _df(), time_column="ts", block_rows=1 << 11)
+    sp.sql("SELECT g, h, sum(v) AS s FROM t GROUP BY g, h")
+    sparse_evs = [e for e in sp.runner.events.snapshot()
+                  if e["event"] == "query"]
+    assert sparse_evs and sparse_evs[0]["path"] == "sparse"
+
+    # shed: occupy the single slot from another thread; queue_limit=0
+    # sheds the next arrival — which never reaches record(), so the
+    # shed event is its entry in the log
+    from tpu_olap.resilience.errors import QueryShed
+    entered, release = threading.Event(), threading.Event()
+
+    def hold():
+        with eng.runner.admission.slot():
+            entered.set()
+            release.wait(10)
+
+    t = threading.Thread(target=hold, daemon=True)
+    t.start()
+    assert entered.wait(5)
+    try:
+        with pytest.raises(QueryShed):
+            eng.sql(GROUP_SQL)
+    finally:
+        release.set()
+        t.join(timeout=10)
+    sheds = [e for e in eng.runner.events.snapshot()
+             if e["event"] == "shed"]
+    assert sheds and sheds[0]["reason"] == "queue_full"
+    assert sheds[0]["query_id"].startswith("q")
+    # every event serializes (the ring's contract)
+    json.dumps(eng.runner.events.snapshot())
+
+
+def test_compensated_device_failure_single_slo_event():
+    """A device failure the engine answers via fallback is ONE logical
+    query: one `query` event + one SLO observation (the fallback's),
+    plus a visible `query_error` for the failed device leg — never a
+    bad+good double count."""
+    calls = {"n": 0}
+
+    def inj(stage, attempt):
+        calls["n"] += 1
+        if calls["n"] <= 10:
+            raise RuntimeError("injected device fault")
+
+    eng = _engine(dispatch_retries=1, fault_injector=inj)
+    out = eng.sql(GROUP_SQL)  # retries exhaust -> fallback answers
+    assert len(out) == 12
+    evs = eng.runner.events.snapshot()
+    assert [e["event"] for e in evs if e["event"] == "query"] == ["query"]
+    assert [e for e in evs if e["event"] == "query_error"]
+    snap = eng.runner.slo.snapshot()
+    assert snap["window_events"] == 1  # the served response only
+
+
+def test_event_ring_bounded_and_ingest_cache_events():
+    eng = _engine(event_log_limit=5)
+    ingests = [e for e in eng.runner.events.snapshot()
+               if e["event"] == "ingest"]
+    assert ingests and ingests[0]["table"] == "t"
+    assert ingests[0]["rows"] == len(_df()) and ingests[0]["accelerated"]
+    eng.sql("CLEAR DRUID CACHE t")
+    clears = [e for e in eng.runner.events.snapshot()
+              if e["event"] == "cache_clear"]
+    assert clears and clears[0]["table"] == "t"
+    for _ in range(12):
+        eng.sql(AGG_SQL)
+    assert len(eng.runner.events.snapshot()) == 5  # ring bounded
+
+
+def test_event_log_file_sink(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    eng = Engine(EngineConfig(event_log_path=path))
+    eng.register_table("t", _df(), time_column="ts", block_rows=1 << 11)
+    eng.sql(AGG_SQL)
+    eng.sql(AGG_SQL)
+    assert eng.runner.events.flush(10.0)  # sink writes are async
+    lines = [json.loads(ln) for ln in
+             open(path).read().strip().splitlines()]
+    assert [e["event"] for e in lines][:1] == ["ingest"]
+    assert sum(1 for e in lines if e["event"] == "query") == 2
+    assert all("ts" in e and "seq" in e for e in lines)
+    seqs = [e["seq"] for e in lines]
+    assert seqs == sorted(seqs)
+
+
+def test_event_log_never_raises():
+    log = EventLog(limit=4, path="/nonexistent-dir/e.jsonl")
+    class Weird:
+        def __repr__(self):
+            return "w" * 1000
+    rec = log.emit("x", exc=RuntimeError("boom"), obj=Weird(),
+                   arr=np.int64(3), f=float("nan"))
+    assert rec["arr"] == 3 and rec["f"] is None
+    assert len(rec["obj"]) <= 300
+    json.dumps(log.snapshot())
+    # the unwritable sink failed in the background, counted not raised
+    log.flush(10.0)
+    assert log.sink_errors >= 1
+    log.close()
+
+
+def test_debug_events_endpoint_param_guard():
+    eng = _engine(event_log_limit=64)
+    for _ in range(4):
+        eng.sql(AGG_SQL)
+    srv = QueryServer(eng).start()
+    try:
+        _, body = _get(srv.url + "/debug/events")
+        doc = json.loads(body)
+        assert doc["limit"] == 64
+        evs = doc["events"]
+        assert evs[0]["event"] == "query"  # newest first
+        _, body = _get(srv.url + "/debug/events?n=2")
+        assert len(json.loads(body)["events"]) == 2
+        # cap at ring size: a huge n is clamped, not honored
+        _, body = _get(srv.url + "/debug/events?n=999999")
+        assert len(json.loads(body)["events"]) <= 64
+        for bad in ("?n=abc", "?n=-3", "?n=1.5"):
+            code, body = _get_code(srv.url + "/debug/events" + bad)
+            assert code == 400, bad
+            assert json.loads(body)["code"] == "user_error"
+        # same guard on /debug/queries (satellite)
+        code, _ = _get_code(srv.url + "/debug/queries?limit=zzz")
+        assert code == 400
+        _, body = _get(srv.url + "/debug/queries?n=1")
+        assert len(json.loads(body)["recent"]) == 1
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------- memory/compile accounting
+
+
+def test_memory_and_compile_metrics_exposed():
+    """Acceptance: after a mixed workload /metrics exposes non-zero
+    live-bytes, cache-entry, recompile, and SLO burn-rate series."""
+    eng = _engine(slo_latency_ms=0.0)  # everything is "bad": burn > 0
+    eng.sql(GROUP_SQL)
+    eng.sql(GROUP_SQL)
+    eng.sql_batch([GROUP_SQL, GROUP2_SQL])
+    srv = QueryServer(eng).start()
+    try:
+        _, text = _get(srv.url + "/metrics")
+    finally:
+        srv.stop()
+
+    def value(line_prefix):
+        hits = [ln for ln in text.splitlines()
+                if ln.startswith(line_prefix)]
+        assert hits, f"{line_prefix} missing from /metrics"
+        return float(hits[0].rsplit(" ", 1)[1])
+
+    assert value('tpu_olap_device_bytes{table="t"}') > 0
+    assert value('tpu_olap_cache_entries{cache="jit"}') >= 1
+    assert value('tpu_olap_cache_entries{cache="plan"}') >= 1
+    assert value('tpu_olap_cache_entries{cache="arg"}') >= 1
+    recompiles = sum(
+        float(ln.rsplit(" ", 1)[1]) for ln in text.splitlines()
+        if ln.startswith("tpu_olap_recompiles_total"))
+    assert recompiles >= 1
+    assert value("tpu_olap_compile_ms_total") > 0
+    assert value("tpu_olap_slo_burn_rate") > 0
+    assert value('tpu_olap_slo_events_total{outcome="bad"}') >= 4
+    # per-query attribution landed in the record schema
+    cold = [h for h in eng.history if h.get("recompiles")]
+    assert cold and all(h.get("compile_ms", 0) > 0 for h in cold)
+    warm = [h for h in eng.history
+            if h.get("cache_hit") and not h.get("recompiles")]
+    assert warm and all("compile_ms" not in h for h in warm)
+
+
+def test_device_bytes_track_clear_and_status():
+    eng = _engine()
+    eng.sql(GROUP_SQL)
+    by_table = eng.runner.device_bytes_by_table()
+    assert by_table.get("t", 0) > 0
+    srv = QueryServer(eng).start()
+    try:
+        _, body = _get(srv.url + "/status")
+        st = json.loads(body)
+        assert st["device_bytes"]["t"] > 0
+        assert st["slo"]["latency_objective_ms"] == 500.0
+        assert "burn_rate" in st["slo"]
+    finally:
+        srv.stop()
+    eng.clear_cache()
+    assert eng.runner.device_bytes_by_table() == {}
+    eng.runner.refresh_resource_gauges()
+    assert eng.runner._m_device_bytes.value(table="t") == 0.0
+
+
+# ----------------------------------------------------------------- SLO
+
+
+def test_slo_tracker_burn_rate_math():
+    slo = SloTracker(latency_ms=10.0, target=0.9, window_s=60.0)
+    slo.observe(5.0)
+    slo.observe(50.0)
+    # bad fraction 1/2 over a 0.1 error budget -> burn 5.0
+    assert abs(slo.burn_rate() - 5.0) < 1e-9
+    assert slo.good_total == 1 and slo.bad_total == 1
+    slo.observe(1.0, failed=True)  # fast but failed: still bad
+    assert slo.bad_total == 2
+    snap = slo.snapshot()
+    assert snap["window_events"] == 3 and snap["window_bad"] == 2
+
+
+# ------------------------------------------------------- bench_compare
+
+
+def _write_bench(path, p50s):
+    with open(path, "w") as f:
+        json.dump({"metric": "ssb_13q_p50_max_ms", "value": 1,
+                   "detail": {"per_query_p50_ms": p50s}}, f)
+
+
+def test_bench_compare_gate(tmp_path):
+    a = str(tmp_path / "a.json")
+    b = str(tmp_path / "b.json")
+    tool = os.path.join(REPO, "tools", "bench_compare.py")
+    _write_bench(a, {"q1": 100.0, "q2": 50.0})
+    _write_bench(b, {"q1": 104.0, "q2": 52.0})
+    ok = subprocess.run([sys.executable, tool, a, b],
+                        capture_output=True, text=True, timeout=60)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    assert "q1" in ok.stdout and "ok" in ok.stdout
+
+    _write_bench(b, {"q1": 130.0, "q2": 52.0})
+    bad = subprocess.run([sys.executable, tool, a, b],
+                         capture_output=True, text=True, timeout=60)
+    assert bad.returncode == 1
+    assert "REGRESSED" in bad.stdout and "q1" in bad.stderr
+
+    # tighter threshold flips the verdict the other way
+    loose = subprocess.run(
+        [sys.executable, tool, a, b, "--threshold", "0.5"],
+        capture_output=True, text=True, timeout=60)
+    assert loose.returncode == 0
+
+    # malformed artifact: usage error, not a crash or a false pass
+    with open(b, "w") as f:
+        json.dump({"nope": 1}, f)
+    err = subprocess.run([sys.executable, tool, a, b],
+                         capture_output=True, text=True, timeout=60)
+    assert err.returncode == 2

@@ -1,0 +1,126 @@
+"""Bench regression gate: compare two BENCH_*.json artifacts.
+
+The bench trajectory was unbanked — every PR prints one JSON line, but
+nothing diffs consecutive runs, so a 20% p50 regression on one query
+rides in silently as long as the worst-case metric holds. This tool is
+the gate CI (and future PRs) call:
+
+    python tools/bench_compare.py BASELINE.json NEW.json
+    python tools/bench_compare.py BENCH_r05.json BENCH_r06.json \
+        --threshold 0.10
+
+It compares `detail.per_query_p50_ms` query by query, prints a delta
+table, and exits non-zero when any query's p50 regressed beyond the
+threshold (default 15%). Queries present in only one artifact are
+reported but never gate (a new query is not a regression; a removed one
+is visible in the table). Sub-millisecond baselines are compared with a
+small absolute floor so timer jitter on trivially fast queries cannot
+trip the gate.
+
+Exit codes: 0 ok, 1 regression(s), 2 usage/artifact error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# relative regressions below this many ms of absolute growth never gate:
+# at sub-ms scale the perf_counter jitter between two runs exceeds any
+# honest percentage threshold
+ABS_FLOOR_MS = 1.0
+
+
+def _fail(msg: str):
+    print(f"bench_compare: {msg}", file=sys.stderr)
+    raise SystemExit(2)
+
+
+def load_p50(path: str) -> dict:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        _fail(f"cannot read {path}: {e}")
+    if not isinstance(doc, dict):
+        _fail(f"{path}: top-level JSON is {type(doc).__name__}, "
+              "not an object (truncated/corrupt artifact?)")
+    if isinstance(doc.get("parsed"), dict) and "detail" not in doc:
+        doc = doc["parsed"]  # driver-banked wrapper (BENCH_rNN.json)
+    per_query = (doc.get("detail") or {}).get("per_query_p50_ms")
+    if not isinstance(per_query, dict) or not per_query:
+        _fail(f"{path} has no detail.per_query_p50_ms "
+              "(not a latency-bench artifact?)")
+    try:
+        return {str(q): float(v) for q, v in per_query.items()}
+    except (TypeError, ValueError) as e:
+        _fail(f"{path}: non-numeric p50 entry: {e}")
+
+
+def compare(base: dict, new: dict, threshold: float):
+    """Rows (query, base_ms, new_ms, delta_frac, regressed) for queries
+    in both artifacts, plus the only-in-one leftovers."""
+    rows = []
+    for q in sorted(set(base) & set(new)):
+        b, n = base[q], new[q]
+        delta = (n - b) / b if b > 0 else (0.0 if n <= 0 else float("inf"))
+        regressed = delta > threshold and (n - b) > ABS_FLOOR_MS
+        rows.append((q, b, n, delta, regressed))
+    only_base = sorted(set(base) - set(new))
+    only_new = sorted(set(new) - set(base))
+    return rows, only_base, only_new
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Compare per-query SSB p50s of two bench artifacts; "
+                    "exit 1 when any query regressed beyond the "
+                    "threshold.")
+    p.add_argument("baseline", help="older BENCH_*.json")
+    p.add_argument("candidate", help="newer BENCH_*.json")
+    p.add_argument(
+        "--threshold", type=float, default=0.15, metavar="FRAC",
+        help="max tolerated relative p50 growth per query "
+             "(default 0.15 = 15%%)")
+    args = p.parse_args(argv)
+    if not (0.0 <= args.threshold < 100.0):
+        p.error(f"--threshold {args.threshold}: must be a fraction >= 0")
+
+    base = load_p50(args.baseline)
+    new = load_p50(args.candidate)
+    rows, only_base, only_new = compare(base, new, args.threshold)
+    if not rows:
+        print("bench_compare: no queries in common — nothing to gate",
+              file=sys.stderr)
+        return 2
+
+    w = max(len(q) for q, *_ in rows)
+    print(f"{'query':<{w}}  {'base ms':>10}  {'new ms':>10}  "
+          f"{'delta':>8}  gate")
+    regressions = []
+    for q, b, n, delta, regressed in rows:
+        flag = "REGRESSED" if regressed else "ok"
+        print(f"{q:<{w}}  {b:>10.3f}  {n:>10.3f}  {delta:>+7.1%}  {flag}")
+        if regressed:
+            regressions.append(q)
+    for q in only_base:
+        print(f"{q:<{w}}  {base[q]:>10.3f}  {'-':>10}  {'':>8}  "
+              "only in baseline")
+    for q in only_new:
+        print(f"{q:<{w}}  {'-':>10}  {new[q]:>10.3f}  {'':>8}  "
+              "only in candidate")
+
+    if regressions:
+        print(f"\nbench_compare: {len(regressions)} quer"
+              f"{'y' if len(regressions) == 1 else 'ies'} regressed "
+              f"past {args.threshold:.0%}: {', '.join(regressions)}",
+              file=sys.stderr)
+        return 1
+    print(f"\nbench_compare: ok ({len(rows)} queries within "
+          f"{args.threshold:.0%})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,7 +13,9 @@ import pandas as pd
 
 from tpu_olap.catalog import Catalog, StarSchema, TableEntry
 from tpu_olap.executor import EngineConfig, QueryRunner
-from tpu_olap.obs.trace import Trace, span as _span, use_query_id
+from tpu_olap.obs.trace import (Trace, current_query_id,
+                                in_nested_execution, nested_execution,
+                                span as _span, use_query_id)
 from tpu_olap.executor.dimplan import UnsupportedDimension
 from tpu_olap.executor.runner import QueryResult
 from tpu_olap.ir.serde import query_from_json
@@ -31,6 +33,30 @@ from tpu_olap.segments.ingest import (DEFAULT_BLOCK_ROWS, ingest_arrow,
 
 _UNSUPPORTED = (UnsupportedAggregation, UnsupportedFilter,
                 UnsupportedGranularity, UnsupportedDimension)
+
+
+def _mark_slo_observed(e: BaseException):
+    """Stamp an exception whose failure was already counted against the
+    SLO (a recorded fallback failure, a raw-IR boundary observation) so
+    the statement-boundary catch-all (Engine._observe_failure) never
+    counts one served failure twice. Only set on exceptions that are
+    NEVER shared across statements — the coalescer fans one exception
+    object out to N callers, and each caller is its own served
+    response, so those must stay unmarked."""
+    try:
+        e._slo_observed = True
+    except Exception:  # noqa: BLE001 — slotted/exotic exceptions
+        pass
+
+
+def _failure_status(e: BaseException) -> int:
+    """HTTP shape of a propagating failure: the taxonomy's http_status,
+    or the server's legacy mapping for untyped errors (api.server:
+    ValueError/KeyError -> 400, rest -> 500)."""
+    status = getattr(e, "http_status", None)
+    if status is None:
+        return 400 if isinstance(e, (ValueError, KeyError)) else 500
+    return int(status)
 
 
 class Engine:
@@ -169,6 +195,11 @@ class Engine:
                            time_column=time_column, star=star,
                            options=dict(options), **pq_fields)
         self.catalog.register(entry)
+        self.runner.events.emit(
+            "ingest", table=name, accelerated=bool(accelerate),
+            rows=segments.num_rows if segments is not None else None,
+            segments=len(segments.segments) if segments is not None
+            else 0)
         return entry
 
     def register_lookup(self, name: str, mapping: dict):
@@ -198,16 +229,39 @@ class Engine:
         from tpu_olap.planner.sqlparse import parse_sql
         with self.tracer.trace("sql") as root:
             root.set(sql=query)
-            with root.span("parse"):
-                stmt = parse_sql(query)
-            with root.span("plan") as sp:
-                plan = self.planner.plan_stmt(stmt, query)
-                sp.set(rewritten=plan.rewritten)
-                if plan.fallback_reason:
-                    sp.set(fallback_reason=plan.fallback_reason)
-            self.last_plan = plan
-            out = self._execute_plan(plan)
+            try:
+                with root.span("parse"):
+                    stmt = parse_sql(query)
+                with root.span("plan") as sp:
+                    plan = self.planner.plan_stmt(stmt, query)
+                    sp.set(rewritten=plan.rewritten)
+                    if plan.fallback_reason:
+                        sp.set(fallback_reason=plan.fallback_reason)
+                self.last_plan = plan
+                out = self._execute_plan(plan)
+            except Exception as e:
+                # statement-boundary SLO accounting: failures that
+                # escaped every inner observation site (e.g. a shed
+                # grouping-sets leg, a planner-subquery refusal) still
+                # count against the budget exactly once
+                self._observe_failure(e)
+                raise
         return out, root if isinstance(root, Trace) else None
+
+    def _observe_failure(self, e: BaseException):
+        """Count a failure propagating to the client against the SLO —
+        exactly once (sites whose record already counted it marked the
+        exception), never for nested statements (the outer statement
+        accounts), and never for client-shaped errors (a 400 for bad
+        SQL must not burn the error budget; 429+ does). Does NOT mark
+        the exception itself: a coalescer-shared exception is one
+        served failure PER caller, and each caller's own boundary runs
+        this exactly once."""
+        if getattr(e, "_slo_observed", False) or in_nested_execution():
+            return
+        if _failure_status(e) < 429:
+            return
+        self.runner.slo.observe(0.0, failed=True)
 
     def _execute_plan(self, plan) -> pd.DataFrame:
         stmt = getattr(plan, "stmt", None)
@@ -216,8 +270,10 @@ class Engine:
             out = self._try_grouping_sets_union(plan)
             if out is not None:
                 return out
+        device_ms = 0.0  # user-visible time burned on a failed device try
         if plan.rewritten:
             res = None
+            t_dev = time.perf_counter()
             try:
                 # the runner serializes dispatch internally
                 # (dispatch_lock) — and with batch_window_ms set,
@@ -228,17 +284,19 @@ class Engine:
             except _UNSUPPORTED as e:
                 plan.query = None
                 plan.fallback_reason = f"lowering failed: {e}"
+                device_ms = (time.perf_counter() - t_dev) * 1000
             except QueryShed:
                 # admission shed = the system is OVERLOADED: routing the
                 # query to the (slower) interpreter would amplify the
-                # overload. Propagate -> HTTP 429, client retries later.
+                # overload. Propagate -> HTTP 429, client retries later
+                # (the statement boundary counts it against the SLO).
                 raise
             except BreakerOpen as e:
                 # breaker open = the DEVICE is sick, the host is fine:
                 # degraded-but-correct serving from the interpreter,
                 # stamped path="fallback_breaker" in the record schema.
                 if not self.config.fallback_on_device_failure:
-                    raise
+                    raise  # refusal: SLO-counted at the boundary
                 plan.query = None
                 plan.breaker_fallback = True
                 plan.fallback_reason = f"breaker open: {e}"
@@ -248,22 +306,30 @@ class Engine:
                 # non-structural failure (device loss, deadline, compiler
                 # bug) -> correct-but-slow fallback, not a user error.
                 if not self.config.fallback_on_device_failure:
+                    # the interim record never SLO-counts; the
+                    # statement boundary counts this propagation
                     raise
                 plan.query = None
                 plan.fallback_reason = \
                     f"device failure: {type(e).__name__}: {e}"
+                device_ms = (time.perf_counter() - t_dev) * 1000
             if res is not None:
                 # conversion bugs in _frame_from must surface, not be
                 # silently reclassified as device failures
                 with _span("render"):
                     return self._frame_from(plan, res)
-        return self._execute_fallback_recorded(plan)
+        return self._execute_fallback_recorded(plan, device_ms)
 
-    def _execute_fallback_recorded(self, plan) -> pd.DataFrame:
+    def _execute_fallback_recorded(self, plan,
+                                   device_ms: float = 0.0) -> pd.DataFrame:
         """Run the pandas fallback under a span AND a history record, so
         the fallback path shares the dashboard metric schema (query_id /
         total_ms / rows_scanned / ... — the observability contract) the
-        device paths emit. Failures record too, then propagate."""
+        device paths emit. Failures record too, then propagate.
+        `device_ms` is the wall already burned on a failed device
+        attempt (deadline wait, exhausted retries): stamped on the
+        record so the SLO classifies the query by the latency the USER
+        saw, not just the fallback's own wall."""
         stmt = plan.stmt
         entry = plan.entry if plan.entry is not None \
             else self.catalog.maybe(getattr(stmt, "table", None) or "")
@@ -274,6 +340,8 @@ class Engine:
         m = {"query_type": "fallback",
              "datasource": getattr(stmt, "table", None) or "(derived)",
              "rows_scanned": rows, "cache_hit": False}
+        if device_ms > 0:
+            m["device_attempt_ms"] = round(device_ms, 3)
         if plan.fallback_reason:
             m["fallback_reason"] = plan.fallback_reason
         if getattr(plan, "breaker_fallback", False):
@@ -283,10 +351,17 @@ class Engine:
             sp.set(reason=plan.fallback_reason)
             try:
                 out = execute_fallback(stmt, self.catalog, self.config)
-            except Exception:
+            except Exception as e:
                 m["failed"] = True
                 m["total_ms"] = (time.perf_counter() - t0) * 1000
+                if _failure_status(e) < 429:
+                    # client-shaped failure (unsupported SQL -> 400):
+                    # recorded and event-logged, but it must not burn
+                    # the SLO error budget (record() honors this key)
+                    m["client_error"] = True
                 self.runner.record(m)
+                if not in_nested_execution():
+                    _mark_slo_observed(e)  # record() accounted for it
                 raise
             m["total_ms"] = (time.perf_counter() - t0) * 1000
             m["rows_returned"] = len(out)
@@ -325,11 +400,15 @@ class Engine:
             if stmt.order_by else []
         if order_keys is None:
             return None  # union ORDER BY must name output columns
+        t0 = time.perf_counter()
         frames, leg_plans = [], []
         for leg_stmt, consts in legs:
             lp = self.planner.plan_stmt(leg_stmt)
             leg_plans.append(lp)
-            f = self._execute_plan(lp)
+            with nested_execution():
+                # legs are internal: one SLO observation + one `query`
+                # event for the whole union, stamped below
+                f = self._execute_plan(lp)
             for name, val in consts.items():
                 # absent group keys reattach as np.nan (float64 NULL),
                 # matching the whole-statement fallback's dtype — a bare
@@ -349,7 +428,20 @@ class Engine:
             out = _sort_order_items(out, order_keys, stmt.order_by)
         lo = stmt.offset
         hi = None if stmt.limit is None else lo + stmt.limit
-        return out.iloc[lo:hi].reset_index(drop=True)
+        out = out.iloc[lo:hi].reset_index(drop=True)
+        # the union is the served response: ONE SLO observation + ONE
+        # `query` event spanning every leg (the legs' own records were
+        # marked nested above)
+        if not in_nested_execution():
+            total_ms = (time.perf_counter() - t0) * 1000
+            self.runner.slo.observe(total_ms)
+            self.runner.events.emit(
+                "query",
+                query_id=current_query_id() or self.tracer.new_query_id(),
+                query_type="groupBy", path="grouping_sets",
+                datasource=stmt.table, total_ms=round(total_ms, 3),
+                cache_hit=False)
+        return out
 
     def sql_batch(self, queries) -> list[pd.DataFrame]:
         """Execute several SQL statements as one submission, fusing
@@ -389,9 +481,20 @@ class Engine:
                 if len(idxs) < 2:
                     continue
                 entry = self.catalog.get(name)
-                boxed = self.runner._execute_batch_boxed(
-                    [plans[i].query for i in idxs], entry.segments,
-                    [qids[i] for i in idxs])
+                try:
+                    boxed = self.runner._execute_batch_boxed(
+                        [plans[i].query for i in idxs], entry.segments,
+                        [qids[i] for i in idxs])
+                except QueryShed:
+                    # a shed aborts the WHOLE submission with 429: every
+                    # statement that has not yet produced a result is a
+                    # user-visible failure, counted per statement like
+                    # the /sql path would (statements that completed
+                    # before the shed keep their good/bad observations)
+                    for o in outs:
+                        if o is None:
+                            self.runner.slo.observe(0.0, failed=True)
+                    raise
                 for i, b in zip(idxs, boxed):
                     if isinstance(b, BaseException):
                         if not isinstance(b, Exception):
@@ -408,7 +511,25 @@ class Engine:
                 # non-fused legs run inside the sql_batch trace but must
                 # record under their OWN statement id, not the root's
                 with use_query_id(qids[i]):
-                    outs[i] = self._execute_plan(plan)
+                    try:
+                        outs[i] = self._execute_plan(plan)
+                    except Exception as e:
+                        # ANY server-shaped abort (shed, breaker
+                        # refusal, device failure with fallback off)
+                        # kills the whole submission: count every
+                        # statement still without a result — including
+                        # this one, unless its own record already
+                        # counted it (marked fallback failures)
+                        if _failure_status(e) >= 429:
+                            for j, o in enumerate(outs):
+                                if o is not None:
+                                    continue
+                                if j == i and getattr(
+                                        e, "_slo_observed", False):
+                                    continue
+                                self.runner.slo.observe(0.0,
+                                                        failed=True)
+                        raise
             if plans:
                 self.last_plan = plans[max(plans)]
         return outs
@@ -417,8 +538,11 @@ class Engine:
         """Execute one parsed statement end-to-end (device path when
         rewritable, else fallback) — the planner's subquery executor.
         Does not touch last_plan: the user-visible plan is the outer
-        query's."""
-        return self._execute_plan(self.planner.plan_stmt(stmt))
+        query's. Marked nested: the inner statement's record must not
+        add a second SLO observation / `query` event to the outer
+        statement's served response."""
+        with nested_execution():
+            return self._execute_plan(self.planner.plan_stmt(stmt))
 
     def _frame_from(self, plan, res: QueryResult) -> pd.DataFrame:
         cols = {}
@@ -465,8 +589,39 @@ class Engine:
             raise UserError(
                 f"table {query.data_source!r} is not accelerated")
         # the runner locks (or coalesces) internally; holding the lock
-        # here would deadlock a coalesced submission against its leader
-        return self.runner.execute(query, entry.segments)
+        # here would deadlock a coalesced submission against its leader.
+        # The root trace makes raw-IR queries first-class in
+        # /debug/queries AND gives the runner's records and the
+        # boundary handlers below one shared query_id, so an operator
+        # can correlate a served failure with its query_error narrative
+        # in /debug/events.
+        with self.tracer.trace("ir", datasource=query.data_source):
+            try:
+                return self.runner.execute(query, entry.segments)
+            except (QueryShed, BreakerOpen):
+                # no record ever fires for a shed/refusal: the
+                # user-visible failure counts against the SLO at this
+                # boundary (the shed/breaker events tell the story).
+                # Never marked: a coalescer-shared exception is one
+                # failure per caller, and nothing downstream of
+                # execute_ir observes this statement again.
+                self.runner.slo.observe(0.0, failed=True)
+                raise
+            except Exception:
+                # the runner's failed record is interim (query_error
+                # event, no SLO count) whatever the config — the raw-IR
+                # path has no fallback, so the propagated failure is
+                # the served response: count it and emit its terminal
+                # `query` event here (unmarked, as above)
+                self.runner.slo.observe(0.0, failed=True)
+                self.runner.events.emit(
+                    "query",
+                    query_id=current_query_id()
+                    or self.tracer.new_query_id(),
+                    query_type=getattr(query, "query_type", "?"),
+                    path="raw_ir", datasource=query.data_source,
+                    total_ms=0.0, cache_hit=False, failed=True)
+                raise
 
     def select_page(self, table: str, columns=None, page_size: int = 100,
                     offset: int = 0, descending: bool = False,

@@ -19,6 +19,16 @@ equivalent is a JSON-over-HTTP surface (stdlib only, no new deps):
                      gauges/counters)
   GET  /debug/queries  recent span trees + the slow-query log ring
                      (EngineConfig.slow_query_ms; docs/OBSERVABILITY.md)
+  GET  /debug/events   the structured event log ring, newest first
+                     (query/breaker/shed/cache_clear/ingest events;
+                     ?n= bounds the count)
+  GET  /debug/profile  recent traces exported as Chrome-trace JSON —
+                     loads directly in Perfetto (?n= bounds traces)
+  POST /debug/profile?ms=N
+                     on-demand jax.profiler capture for N ms (capped);
+                     dispatches inside the window are annotated with
+                     their query_id. Degrades to {"ok": false, ...}
+                     where the profiler is unavailable.
   GET  /healthz      liveness: 200 while the process serves requests
   GET  /readyz       readiness: 503 while the device circuit breaker is
                      open or the device is wedged — tells a load
@@ -54,7 +64,39 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import pandas as pd
 
-from tpu_olap.resilience.errors import QueryError
+from tpu_olap.resilience.errors import QueryError, UserError
+
+
+def _parse_query(path: str) -> dict:
+    """Query-string dict of a request path ({} when none)."""
+    if "?" not in path:
+        return {}
+    from urllib.parse import parse_qs
+    return parse_qs(path.split("?", 1)[1])
+
+
+def _int_param(qs: dict, names, cap: int | None = None,
+               default: int | None = None) -> int | None:
+    """Validated integer query param shared by the /debug endpoints
+    (ISSUE 8 satellite): first present name wins, non-integers and
+    negatives are rejected with a 400 UserError (not a 500 traceback),
+    and values are capped (at the serving ring's size) so a client
+    cannot request an unbounded response."""
+    for nm in names:
+        vals = qs.get(nm)
+        if not vals:
+            continue
+        raw = vals[0]
+        try:
+            v = int(raw)
+        except (TypeError, ValueError):
+            raise UserError(
+                f"query param {nm}={raw!r}: must be an integer")
+        if v < 0:
+            raise UserError(
+                f"query param {nm}={raw!r}: must be >= 0")
+        return v if cap is None else min(v, cap)
+    return default
 
 
 def _jsonable(x):
@@ -208,6 +250,10 @@ class QueryServer:
         self.httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+        # the JSONL event sink writes asynchronously: give the tail
+        # emitted by draining handlers (a final shed burst, a breaker
+        # trip) a bounded chance to reach disk before the process exits
+        self.engine.runner.events.flush(2.0)
 
     @property
     def url(self) -> str:
@@ -247,6 +293,8 @@ class QueryServer:
                     "wedged": bool(eng.runner._wedged),
                     "admission": eng.runner.admission.snapshot(),
                 },
+                "slo": eng.runner.slo.snapshot(),
+                "device_bytes": eng.runner.device_bytes_by_table(),
             }
         if path.startswith("/status/metadata/"):
             name = path.rsplit("/", 1)[1]
@@ -256,13 +304,25 @@ class QueryServer:
             return {"table": name,
                     "columns": entry.segments.column_metadata()}
         if path == "/debug/queries" or path.startswith("/debug/queries?"):
-            limit = None
-            if "?" in path:
-                from urllib.parse import parse_qs
-                qs = parse_qs(path.split("?", 1)[1])
-                if qs.get("limit"):
-                    limit = int(qs["limit"][0])
+            limit = _int_param(_parse_query(path), ("n", "limit"),
+                               cap=self.engine.tracer.ring_limit)
             return self.engine.tracer.snapshot(limit)
+        if path == "/debug/events" or path.startswith("/debug/events?"):
+            ev = self.engine.runner.events
+            n = _int_param(_parse_query(path), ("n", "limit"),
+                           cap=ev.limit)
+            out = {"limit": ev.limit, "events": ev.snapshot(n)}
+            if ev.path is not None:
+                out["sink"] = {"path": ev.path,
+                               "errors": ev.sink_errors}
+            return out
+        if path == "/debug/profile" or path.startswith("/debug/profile?"):
+            # span-tree timelines in Chrome-trace JSON (obs.profile):
+            # save the body to a file and open it in Perfetto
+            from tpu_olap.obs.profile import chrome_trace
+            n = _int_param(_parse_query(path), ("n", "limit"),
+                           cap=self.engine.tracer.ring_limit)
+            return chrome_trace(self.engine.tracer.recent_traces(n))
         raise KeyError(f"unknown path {path!r}")
 
     def _get_metrics(self) -> str:
@@ -278,6 +338,11 @@ class QueryServer:
                 "Records retained in the bounded history ring.") \
             .set(len(eng.runner.history))
         m.gauge("tables_registered").set(len(eng.catalog.names()))
+        # memory/cache gauges + the SLO burn rate are point-in-time:
+        # walk resident buffers and re-prune the SLO window at scrape,
+        # not per query
+        eng.runner.refresh_resource_gauges()
+        m.gauge("slo_burn_rate").set(eng.runner.slo.burn_rate())
         return m.render()
 
     def _post(self, path: str, body: str):
@@ -298,4 +363,14 @@ class QueryServer:
             spec = json.loads(body)
             res = self.engine.execute_ir(spec)
             return res.druid
+        if path == "/debug/profile" or path.startswith("/debug/profile?"):
+            # on-demand device capture: blocks THIS handler thread for
+            # the window while other threads keep serving (their
+            # dispatches get query_id annotations); ms is validated and
+            # capped like every /debug param
+            from tpu_olap.obs import profile as profile_mod
+            ms = _int_param(_parse_query(path), ("ms",),
+                            cap=profile_mod.CAPTURE_MS_MAX,
+                            default=profile_mod.CAPTURE_MS_DEFAULT)
+            return profile_mod.capture_device_profile(ms)
         raise KeyError(f"unknown path {path!r}")

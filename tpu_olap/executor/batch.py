@@ -45,7 +45,8 @@ from tpu_olap.executor.runner import QueryResult, _next_pow2
 from tpu_olap.ir.query import (GroupByQuerySpec, TimeseriesQuerySpec,
                                TopNQuerySpec)
 from tpu_olap.kernels.groupby import group_reduce_batch, merge_partials
-from tpu_olap.obs.trace import current_query_id, span as _span
+from tpu_olap.obs.trace import (current_query_id, span as _span,
+                                use_query_id)
 from tpu_olap.resilience.errors import InternalError
 from tpu_olap.resilience.faults import maybe_inject
 
@@ -122,16 +123,17 @@ def run_batch(runner, queries, table, query_ids=None) -> list:
         try:
             # _execute_locked, not _execute: the single-leg path keeps
             # the deadline watchdog + wedged-device reprobe of a plain
-            # execute() call (run_batch's caller holds dispatch_lock)
-            res = runner._execute_locked(q, table)
+            # execute() call (run_batch's caller holds dispatch_lock).
+            # The statement's own id is propagated BEFORE record()
+            # fires, so the history record and its `query` event agree
+            # (a post-hoc rewrite would leave the event carrying the
+            # leader's trace id).
+            with use_query_id(query_ids[idxs[0]] or None):
+                res = runner._execute_locked(q, table)
         except BaseException as e:  # noqa: BLE001 — boxed per leg
             for i in idxs:
                 boxed[i] = e
             continue
-        if query_ids[idxs[0]]:
-            # re-attribute: _execute_locked recorded under the leader's
-            # context; the history record shares this dict
-            res.metrics["query_id"] = query_ids[idxs[0]]
         if len(idxs) > 1:
             m = res.metrics
             m["batch_id"] = runner._next_batch_id()
@@ -213,7 +215,11 @@ def _fan_out(runner, boxed, res, idxs, queries, query_ids=None):
         m = {**res.metrics, "batch_dedup": True}
         # a duplicate is its own logical query: never inherit the
         # computing leg's id (record() would otherwise stamp the batch
-        # leader's trace id on every fan-out copy)
+        # leader's trace id on every fan-out copy) — nor its compile
+        # attribution (one executable build must not re-increment
+        # compile_ms_total once per duplicate)
+        m.pop("recompiles", None)
+        m.pop("compile_ms", None)
         m["query_id"] = (query_ids[i] if query_ids and query_ids[i]
                          else runner.tracer.new_query_id())
         dup = QueryResult(queries[i], res.rows, res.druid, m)
@@ -274,6 +280,11 @@ def _run_fused(runner, table, group, query_ids=None):
                batch_size=n_logical) as ssp:
         partials_list, shared_ms, agg_ms, hit = runner._guarded_dispatch(
             dispatch, metrics_list[0], table.name)
+        if not hit and runner.config.platform != "cpu":
+            # one fused executable per batch composition: attribute the
+            # build to the first leg's record (counting it on every leg
+            # would multiply one compile by batch_legs in /metrics)
+            runner._note_compile("batch", metrics_list[0])
         ssp.set(cache_hit=hit, scan_ms_shared=round(shared_ms, 3))
 
         results = []

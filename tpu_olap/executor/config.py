@@ -228,6 +228,21 @@ class EngineConfig:
     # incrementally at record time, never re-summed from (possibly
     # evicted) history.
     history_limit: int = 1024
+    # structured event log (obs.events): engine-level occurrences
+    # (query completion, breaker transitions, admission sheds, cache
+    # clears, ingest) land in a bounded ring served by GET /debug/events;
+    # event_log_path additionally appends each event as one JSON line to
+    # that file (durable sink for a log pipeline). None = ring only.
+    event_log_limit: int = 2048
+    event_log_path: str | None = None
+    # latency SLO (obs.slo): queries completing within slo_latency_ms
+    # count good, others (and failures/sheds) bad; the burn-rate gauge
+    # is bad_fraction over slo_window_s divided by the error budget
+    # (1 - slo_target). Defaults mirror the bench north star
+    # (BASELINE.md: every SSB query < 500 ms).
+    slo_latency_ms: float = 500.0
+    slo_target: float = 0.99
+    slo_window_s: float = 3600.0
 
     # Pallas fused one-hot MXU reduce (kernels.pallas_reduce): "auto" uses
     # it on the TPU backend for eligible plans, "force" uses it everywhere

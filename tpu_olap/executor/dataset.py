@@ -209,6 +209,21 @@ class DeviceDataset:
                       if (m := self.null_mask(c, pinned)) is not None},
         }
 
+    def resident_bytes(self) -> int:
+        """Live device bytes this dataset holds right now: column/null/
+        derived stacks plus the validity mask, via each buffer's own
+        nbytes (jax Arrays and numpy arrays both expose it) — the
+        per-table series behind `tpu_olap_device_bytes{table=...}`.
+        list() snapshots tolerate the abandoned-deadline-thread
+        concurrency the cache dicts already allow."""
+        total = 0
+        for store in (self._cols, self._nulls, self._derived):
+            for arr in list(store.values()):
+                total += int(getattr(arr, "nbytes", 0) or 0)
+        if self._valid is not None:
+            total += int(getattr(self._valid, "nbytes", 0) or 0)
+        return total
+
     def evict(self):
         self._cols.clear()
         self._nulls.clear()

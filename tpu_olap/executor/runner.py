@@ -17,8 +17,12 @@ import numpy as np
 
 from tpu_olap.executor.config import EngineConfig
 from tpu_olap.executor.dataset import DeviceDataset
+from tpu_olap.obs.events import EventLog
 from tpu_olap.obs.metrics import MetricsRegistry
-from tpu_olap.obs.trace import (Tracer, current_query_id, short_str,
+from tpu_olap.obs.profile import annotate_dispatch
+from tpu_olap.obs.slo import SloTracker
+from tpu_olap.obs.trace import (Tracer, current_query_id,
+                                in_nested_execution, short_str,
                                 span as _span)
 from tpu_olap.resilience.admission import AdmissionController
 from tpu_olap.resilience.breaker import CircuitBreaker
@@ -164,6 +168,17 @@ class QueryRunner:
                              slow_ms=self.config.slow_query_ms,
                              slow_limit=self.config.slow_log_limit)
         self.metrics = MetricsRegistry()
+        # structured event log (obs.events): query completions, breaker
+        # transitions, admission sheds, cache clears, ingest — the ring
+        # behind GET /debug/events, with an optional JSONL file sink
+        self.events = EventLog(limit=self.config.event_log_limit,
+                               path=self.config.event_log_path)
+        # latency SLO accounting (obs.slo): every record() classifies
+        # good/bad against slo_latency_ms and updates the burn-rate gauge
+        self.slo = SloTracker(self.config.slo_latency_ms,
+                              self.config.slo_target,
+                              self.config.slo_window_s,
+                              metrics=self.metrics)
         self._totals_lock = threading.Lock()
         self._profile_seq = 0  # profiler trace dirs outlive ring eviction
         self._totals = {"queries": 0, "rows_scanned": 0,
@@ -201,16 +216,43 @@ class QueryRunner:
             "degraded_queries_total",
             "Queries served by the interpreter while the breaker was "
             "open (path=fallback_breaker).")
+        # memory & compile accounting (ISSUE 8): live device bytes per
+        # table, cache population/eviction, and executable builds — the
+        # gauges are point-in-time, refreshed by refresh_resource_gauges
+        # at scrape; the counters update at their event sites
+        self._m_device_bytes = m.gauge(
+            "device_bytes",
+            "Live device bytes resident per table (segment/derived "
+            "buffers + cached const/seg-mask uploads, via nbytes).",
+            ("table",))
+        self._m_cache_entries = m.gauge(
+            "cache_entries", "Entries in the runner caches.", ("cache",))
+        self._m_cache_evict = m.counter(
+            "cache_evictions_total",
+            "Capacity evictions from the runner caches.", ("cache",))
+        self._m_cache_clears = m.counter(
+            "cache_clears_total",
+            "Explicit cache clears (CLEAR DRUID CACHE / recovery "
+            "purges).", ("scope",))
+        self._m_recompile = m.counter(
+            "recompiles_total",
+            "Device executables built (jit-cache misses), by dispatch "
+            "flavor.", ("kind",))
+        self._m_compile_ms = m.counter(
+            "compile_ms_total",
+            "Milliseconds spent in cold dispatches that built an "
+            "executable (trace + XLA compile + first execution).")
         # resilience layer (tpu_olap.resilience; docs/RESILIENCE.md):
         # bounded admission in front of dispatch_lock, plus the device
         # circuit breaker whose healer probes via _healer_probe
         self.admission = AdmissionController(
             self.config.max_inflight_dispatches,
-            self.config.admission_queue_limit, metrics=m)
+            self.config.admission_queue_limit, metrics=m,
+            events=self.events)
         self.breaker = CircuitBreaker(
             self.config.breaker_failure_threshold,
             self.config.breaker_open_cooldown_s,
-            probe=self._healer_probe, metrics=m)
+            probe=self._healer_probe, metrics=m, events=self.events)
         self._attempt_local = threading.local()  # host-transfer inject
 
     def _inject(self, stage: str):
@@ -257,6 +299,19 @@ class QueryRunner:
             m.setdefault(k, v)
         qt, path = m["query_type"], self._metric_path(m)
         m["path"] = path
+        if qt == "?":
+            # runner NOTES (healer/reprobe outcomes), not queries: they
+            # log + land in history but must not inflate queries_total,
+            # the latency histogram, or the /status totals — a breaker
+            # outage's healer loop would otherwise add one phantom 0 ms
+            # "query" per cooldown for exactly the window an operator
+            # is debugging
+            self.events.emit(
+                "device", query_id=m["query_id"],
+                **{k: v for k, v in m.items()
+                   if k.startswith("device_probe")})
+            self.history.append(m)
+            return m
         with self._totals_lock:
             t = self._totals
             t["queries"] += 1
@@ -286,8 +341,106 @@ class QueryRunner:
             self._m_hbm_bytes.set(m["hbm_bytes"])
         if "hbm_evictions" in m:
             self._m_hbm_evict.set_total(m["hbm_evictions"])
+        if m.get("recompiles"):
+            # cold-dispatch wall: the miss's first call is where tracing
+            # + XLA compilation happen, so a recompile storm shows up as
+            # compile_ms on the records that paid it (and in the
+            # compile_ms_total counter). Approximate by construction —
+            # it includes the first execution (docs/OBSERVABILITY.md).
+            m.setdefault("compile_ms",
+                         m.get("execute_ms") or m.get("scan_ms_shared")
+                         or 0.0)
+            self._m_compile_ms.inc(m["compile_ms"] or 0.0)
+        if in_nested_execution():
+            # an internal leg of a larger statement (grouping-sets
+            # union, planner subquery, fallback derived table): it
+            # keeps its history record and per-path metrics, but the
+            # SLO observation and `query` event belong to the OUTER
+            # statement — one served response, one event
+            self.history.append(m)
+            return m
+        # SLO classification + the structured event log: record() is the
+        # one chokepoint every per-query record passes through, so both
+        # see every path (dense/sparse/fallback/batch leg/failed).
+        # INTERIM device failures (failed/deadline records on a
+        # non-fallback path) log as `query_error`, not `query`, and are
+        # never SLO-counted here: the served outcome is accounted
+        # exactly once elsewhere — by the compensating fallback record
+        # when the engine falls back, or at the statement/raw-IR
+        # boundary (Engine._observe_failure / execute_ir) when the
+        # failure propagates to the client. Everything else is a served
+        # response: one `query` event + one SLO observation.
+        failed = bool(m.get("failed") or m.get("deadline_exceeded"))
+        interim = failed and qt != "fallback"
+        if interim:
+            self.events.emit(
+                "query_error", query_id=m["query_id"], query_type=qt,
+                path=path, datasource=m["datasource"],
+                total_ms=round(m["total_ms"] or 0.0, 3),
+                **({"deadline_exceeded": True}
+                   if m.get("deadline_exceeded") else {}))
+        else:
+            # the SLO sees the USER-VISIBLE latency: a compensating
+            # fallback adds the wall its query already burned on the
+            # failed device attempt (deadline wait, exhausted retries).
+            # Client-shaped failures (unsupported SQL -> 400) are
+            # event-logged but never burn the error budget.
+            if not (failed and m.get("client_error")):
+                self.slo.observe((m["total_ms"] or 0.0)
+                                 + (m.get("device_attempt_ms") or 0.0),
+                                 failed=failed)
+            self.events.emit(
+                "query", query_id=m["query_id"], query_type=qt,
+                path=path, datasource=m["datasource"],
+                total_ms=round(m["total_ms"] or 0.0, 3),
+                cache_hit=bool(m["cache_hit"]),
+                **({"failed": True} if failed else {}))
         self.history.append(m)
         return m
+
+    def _note_compile(self, kind: str, metrics: dict | None = None):
+        """Called at every jit-cache miss that builds a device
+        executable: bumps the recompile counter (by dispatch flavor) and
+        stamps the record so record() can attribute compile_ms — the
+        signal that makes a recompile storm (cap churn, layout drift,
+        config flapping) visible in /metrics instead of just 'queries
+        got slow'."""
+        self._m_recompile.inc(kind=kind)
+        if metrics is not None:
+            metrics["recompiles"] = metrics.get("recompiles", 0) + 1
+
+    def device_bytes_by_table(self) -> dict:
+        """Live device bytes per table: each dataset's resident column/
+        null/derived stacks plus this table's cached const/seg-mask
+        uploads (_arg_cache keys lead with the table name). Snapshots
+        tolerate the abandoned-thread concurrency the caches allow."""
+        out: dict = {}
+        for name, ds in list(self._datasets.items()):
+            out[name] = ds.resident_bytes()
+        for key, val in list(self._arg_cache.items()):
+            try:
+                consts_dev, seg_arg = val
+                n = sum(int(getattr(a, "nbytes", 0) or 0)
+                        for a in consts_dev.values())
+                n += int(getattr(seg_arg, "nbytes", 0) or 0)
+            except Exception:  # noqa: BLE001 — accounting, not serving
+                continue
+            out[key[0]] = out.get(key[0], 0) + n
+        return out
+
+    def refresh_resource_gauges(self):
+        """Point-in-time memory/cache gauges, refreshed at scrape time
+        (GET /metrics) rather than per query — walking every resident
+        buffer is O(buffers), too heavy for the per-record hot path."""
+        by_table = self.device_bytes_by_table()
+        for t, b in by_table.items():
+            self._m_device_bytes.set(b, table=t)
+        for key in list(self._m_device_bytes.series):
+            if key[0] not in by_table:  # evicted table: zero, not stale
+                self._m_device_bytes.set(0.0, table=key[0])
+        self._m_cache_entries.set(len(self._jit_cache), cache="jit")
+        self._m_cache_entries.set(len(self._plan_cache), cache="plan")
+        self._m_cache_entries.set(len(self._arg_cache), cache="arg")
 
     def counters(self) -> dict:
         """Aggregate counters, maintained incrementally at record time —
@@ -320,7 +473,12 @@ class QueryRunner:
             try:
                 maybe_inject(self.config, "dispatch", attempt)
                 self._attempt_local.value = attempt
-                out = call()
+                # while an on-demand jax.profiler capture is live
+                # (obs.profile), annotate this dispatch with its
+                # query_id so the captured XLA ops nest under the query;
+                # otherwise a single module-flag probe
+                with annotate_dispatch(current_query_id()):
+                    out = call()
                 # success resets the breaker's consecutive-failure count
                 self.breaker.record_success()
                 return out
@@ -402,7 +560,10 @@ class QueryRunner:
             self._reprobe_device(deadline)
         return self._join_abandoning(
             lambda: self._dispatch(call, metrics, table_name), deadline,
-            {"datasource": table_name, "batch_dispatch": True},
+            {"datasource": table_name, "batch_dispatch": True,
+             "query_type": "batch"},  # a real failure record, not a
+            #                           runner note (record() routes
+            #                           query_type "?" to the note path)
             name="tpu-olap-batch-dispatch")
 
     def execute(self, query, table) -> QueryResult:
@@ -410,7 +571,11 @@ class QueryRunner:
         # routes fallback-capable queries to the interpreter) instead of
         # queueing doomed work onto the sick device
         self.breaker.check()
-        if self._coalescer is not None:
+        if self._coalescer is not None and not in_nested_execution():
+            # nested statements (subqueries, derived tables) dispatch
+            # directly: the coalescer's leader would record their legs
+            # OUTSIDE the nested context, double-counting them in the
+            # SLO/event accounting (obs.trace.nested_execution)
             from tpu_olap.executor.batch import AGG_QUERY_TYPES
             if isinstance(query, AGG_QUERY_TYPES):
                 # waits OUTSIDE dispatch_lock so concurrent callers can
@@ -633,6 +798,7 @@ class QueryRunner:
         plan = lower(query, table, self.config)
         if len(self._plan_cache) > 512:
             _evict_one(self._plan_cache)
+            self._m_cache_evict.inc(cache="plan")
         self._plan_cache[key] = (table, plan)
         return plan
 
@@ -655,6 +821,12 @@ class QueryRunner:
     def clear_cache(self, table_name: str | None = None):
         """Evict device-resident columns (+ compiled programs if full clear).
         The analog of `CLEAR DRUID CACHE` (SURVEY.md §4.5)."""
+        self._m_cache_clears.inc(scope="table" if table_name else "full")
+        self.events.emit(
+            "cache_clear", table=table_name or "*",
+            jit_entries=len(self._jit_cache),
+            plan_entries=len(self._plan_cache),
+            arg_entries=len(self._arg_cache))
         # list() snapshots: an abandoned deadline thread may insert
         # concurrently (see _run_with_deadline) — never iterate live dicts
         if table_name is None:
@@ -908,6 +1080,7 @@ class QueryRunner:
             else:
                 jitted = jax.jit(plan.kernel)
             self._jit_cache[key] = jitted
+            self._note_compile("partials", metrics)
         t0 = time.perf_counter()
         with _span("dispatch", cache_hit=hit,
                    num_shards=mesh.devices.size if mesh else 1):
@@ -951,6 +1124,7 @@ class QueryRunner:
             seg_arg = jax.device_put(seg_mask)
         if len(self._arg_cache) > 256:
             _evict_one(self._arg_cache)
+            self._m_cache_evict.inc(cache="arg")
         self._arg_cache[ckey] = (consts_dev, seg_arg)
         return consts_dev, seg_arg
 
@@ -1015,6 +1189,8 @@ class QueryRunner:
             while True:
                 jitted, layout, hit = self._packed_jit(plan, cap, mesh,
                                                        strategy, win)
+                if not hit:
+                    self._note_compile("packed", metrics)
                 buf = jitted(env, valid, seg_arg, consts_dev, win[0]) \
                     if win is not None else \
                     jitted(env, valid, seg_arg, consts_dev)
@@ -1115,6 +1291,7 @@ class QueryRunner:
                     else:
                         jitted = jax.jit(kern)
                     self._jit_cache[key] = jitted
+                    self._note_compile("sparse", metrics)
                 out = jitted(env, valid, seg_arg, consts_dev, win[0]) \
                     if win is not None else \
                     jitted(env, valid, seg_arg, consts_dev)
@@ -1149,6 +1326,7 @@ class QueryRunner:
                     jitted = jax.jit(sharded_sparse_exchange_kernel(
                         kern, plan, mesh, cap, cap_owner))
                     self._jit_cache[key] = jitted
+                    self._note_compile("sparse", metrics)
                 out = jitted(env, valid, seg_arg, consts_dev)
                 count = int(out["_count"])
                 local_max = int(out["_local_max"])

@@ -6,12 +6,19 @@ in-process analog: `trace` yields a per-query span tree (parse → plan →
 lower → prepare → dispatch → host-transfer → finalize → post-agg →
 assemble, with batch legs nested under their shared-scan span), `metrics`
 maintains incrementally-updated counters/gauges/histograms rendered in
-Prometheus text exposition format. No new dependencies — monotonic clocks,
-contextvars propagation, stdlib formatting only.
+Prometheus text exposition format, `profile` exports span trees as
+Chrome-trace/Perfetto timelines and wraps on-demand jax.profiler
+captures, `events` is the structured JSON-lines event log, and `slo`
+tracks latency objectives with a burn-rate gauge. No new dependencies —
+monotonic clocks, contextvars propagation, stdlib formatting only.
 """
 
+from tpu_olap.obs.events import EventLog  # noqa: F401
 from tpu_olap.obs.metrics import (Counter, Gauge, Histogram,  # noqa: F401
                                   LATENCY_BUCKETS_MS, MetricsRegistry)
+from tpu_olap.obs.profile import (annotate_dispatch,  # noqa: F401
+                                  capture_device_profile, chrome_trace)
+from tpu_olap.obs.slo import SloTracker  # noqa: F401
 from tpu_olap.obs.trace import (NULL_SPAN, Span, Trace,  # noqa: F401
                                 Tracer, current_query_id, current_span,
                                 span)

@@ -35,6 +35,8 @@ _current_span: contextvars.ContextVar = contextvars.ContextVar(
     "tpu_olap_current_span", default=None)
 _current_qid: contextvars.ContextVar = contextvars.ContextVar(
     "tpu_olap_current_query_id", default=None)
+_nested_exec: contextvars.ContextVar = contextvars.ContextVar(
+    "tpu_olap_nested_exec", default=False)
 
 # attribute values are clipped at record time so a span tree is always
 # JSON-small (an exception repr or a full SQL text must not bloat the
@@ -78,14 +80,15 @@ class Span:
     exited on one thread; concurrent siblings guard the children list
     with the owning trace's lock."""
 
-    __slots__ = ("name", "attrs", "children", "t0", "duration_ms",
-                 "_token", "_trace")
+    __slots__ = ("name", "attrs", "children", "t0", "start_ms",
+                 "duration_ms", "_token", "_trace")
 
     def __init__(self, name: str, trace: "Trace | None" = None):
         self.name = name
         self.attrs: dict = {}
         self.children: list = []
         self.t0: float | None = None
+        self.start_ms: float | None = None  # offset from the trace root
         self.duration_ms: float | None = None
         self._token = None
         self._trace = trace
@@ -113,6 +116,14 @@ class Span:
 
     def __enter__(self) -> "Span":
         self.t0 = time.perf_counter()
+        # start position on the trace timeline: offset from the root's
+        # monotonic t0 (perf_counter is one clock across threads, so
+        # cross-thread dispatch spans position correctly). Without it a
+        # tree has durations but no layout — concurrent legs could not
+        # be placed on a timeline (obs.profile's Chrome-trace export).
+        tr = self._trace
+        self.start_ms = 0.0 if tr is self or tr is None or tr.t0 is None \
+            else (self.t0 - tr.t0) * 1000
         self._token = _current_span.set(self)
         return self
 
@@ -129,6 +140,8 @@ class Span:
 
     def to_json(self) -> dict:
         out = {"name": self.name,
+               "start_ms": None if self.start_ms is None
+               else round(self.start_ms, 3),
                "duration_ms": None if self.duration_ms is None
                else round(self.duration_ms, 3)}
         if self.attrs:
@@ -187,6 +200,29 @@ def span(name: str, **attrs):
     if cur is None:
         return NULL_SPAN
     return cur.span(name, **attrs)
+
+
+class nested_execution:
+    """Marks statements executed INSIDE another statement (grouping-sets
+    legs, planner subqueries, fallback derived tables). Their records
+    keep history/metrics behavior, but QueryRunner.record() excludes
+    them from the SLO and the `query` event stream — one served
+    response must yield exactly one event + one SLO observation, not
+    one per internal leg."""
+
+    __slots__ = ("_token",)
+
+    def __enter__(self):
+        self._token = _nested_exec.set(True)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        _nested_exec.reset(self._token)
+        return False
+
+
+def in_nested_execution() -> bool:
+    return _nested_exec.get()
 
 
 class use_query_id:
@@ -292,6 +328,16 @@ class Tracer:
                 if len(self.slow) > self.slow_limit:
                     del self.slow[0]
 
+    def recent_traces(self, limit: int | None = None) -> list:
+        """Completed Trace OBJECTS from the recent ring (oldest first),
+        for exporters that need spans rather than the JSON snapshot
+        (obs.profile.chrome_trace)."""
+        with self._lock:
+            recent = list(self.recent)
+        if limit is None:
+            return recent
+        return recent[-limit:] if limit > 0 else []
+
     def snapshot(self, limit: int | None = None) -> dict:
         """JSON view for GET /debug/queries: recent span trees (newest
         first) + the slow-query ring."""
@@ -299,8 +345,9 @@ class Tracer:
             recent = list(self.recent)
             slow = list(self.slow)
         if limit is not None:
-            recent = recent[-limit:]
-            slow = slow[-limit:]
+            # -0 would slice the WHOLE list: n=0 must mean "none"
+            recent = recent[-limit:] if limit > 0 else []
+            slow = slow[-limit:] if limit > 0 else []
         return {
             "slow_query_ms": self.slow_ms,
             "recent": [t.to_json() for t in reversed(recent)],

@@ -51,9 +51,10 @@ class AdmissionController:
     """
 
     def __init__(self, max_inflight: int, queue_limit: int,
-                 metrics=None):
+                 metrics=None, events=None):
         self.max_inflight = int(max_inflight)
         self.queue_limit = max(0, int(queue_limit))
+        self._events = events  # obs.events.EventLog (optional)
         self._cond = threading.Condition()
         self._inflight = 0
         self._queued = 0
@@ -94,9 +95,22 @@ class AdmissionController:
         return ahead * self._service_ewma_s / max(1, self.max_inflight)
 
     def _shed(self, reason: str, msg: str):
+        # metric inc only (a few dict ops): _shed fires while the caller
+        # holds self._cond, so the event emission — which may write to
+        # the JSONL file sink — happens in slot(), outside the lock
         if self._m_shed is not None:
             self._m_shed.inc(reason=reason)
         raise QueryShed(msg, reason=reason)
+
+    def _emit_shed(self, e: QueryShed):
+        """Shed event, emitted OUTSIDE self._cond: a slow event-log file
+        sink must not stall every other thread's admission. A shed query
+        never reaches QueryRunner.record(), so this event IS its entry
+        in the structured log."""
+        if self._events is not None:
+            from tpu_olap.obs.trace import current_query_id
+            self._events.emit("shed", reason=e.reason, detail=str(e),
+                              query_id=current_query_id())
 
     # ------------------------------------------------------------- slot
 
@@ -112,7 +126,11 @@ class AdmissionController:
         if self.max_inflight <= 0 or getattr(self._local, "held", 0):
             yield
             return
-        waited_ms = self._admit(budget_s)
+        try:
+            waited_ms = self._admit(budget_s)
+        except QueryShed as e:
+            self._emit_shed(e)
+            raise
         if self._m_wait is not None:
             self._m_wait.observe(waited_ms)
         self._local.held = 1

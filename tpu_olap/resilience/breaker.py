@@ -37,10 +37,13 @@ CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
 
 class CircuitBreaker:
     def __init__(self, failure_threshold: int, cooldown_s: float,
-                 probe=None, metrics=None):
+                 probe=None, metrics=None, events=None):
         self.failure_threshold = int(failure_threshold)
         self.cooldown_s = max(0.05, float(cooldown_s))
         self.probe = probe          # () -> bool; set by the runner
+        self._events = events       # obs.events.EventLog (optional)
+        self._pending_events: list = []  # emitted outside self._lock
+        self._emit_lock = threading.Lock()  # flushers, in pop order
         self._lock = threading.Lock()
         self._state = CLOSED
         self._consecutive = 0
@@ -71,11 +74,36 @@ class CircuitBreaker:
         # caller holds self._lock
         if state == self._state:
             return
-        self._state = state
+        prev, self._state = self._state, state
         if self._m_state is not None:
             self._m_state.set(STATE_VALUES[state])
         if self._m_trans is not None:
             self._m_trans.inc(state=state)
+        if self._events is not None:
+            # queued, not emitted: emit may write to the JSONL file
+            # sink, and a hung sink must not stall every dispatch's
+            # record_success/record_failure behind our lock — callers
+            # flush after releasing it (_flush_events)
+            self._pending_events.append(
+                {"state": state, "previous": prev,
+                 "consecutive_failures": self._consecutive})
+
+    def _flush_events(self):
+        """Emit transitions queued by _set_state, OUTSIDE self._lock."""
+        # unlocked empty probe: the common path (no transition) must not
+        # pay a second lock round-trip per dispatch. A racing append is
+        # never lost — the appending mutator flushes after its own
+        # mutation.
+        if self._events is None or not self._pending_events:
+            return
+        # _emit_lock serializes pop+emit across concurrent flushers, so
+        # the log's transition order always matches the state machine's
+        # (emit only enqueues to the async sink — never file I/O here)
+        with self._emit_lock:
+            with self._lock:
+                pending, self._pending_events = self._pending_events, []
+            for p in pending:
+                self._events.emit("breaker", **p)
 
     # ------------------------------------------------------------ events
 
@@ -102,6 +130,7 @@ class CircuitBreaker:
             self._consecutive = 0
             if self._state == HALF_OPEN:
                 self._set_state(CLOSED)
+        self._flush_events()
 
     def record_failure(self, kind: str = "failure"):
         """A terminal device failure (retries exhausted, deadline hit,
@@ -115,6 +144,7 @@ class CircuitBreaker:
             if self._state != OPEN and \
                     self._consecutive >= self.failure_threshold:
                 self._trip_locked()
+        self._flush_events()
 
     def _trip_locked(self):
         self.trips_total += 1
@@ -138,6 +168,7 @@ class CircuitBreaker:
         with self._lock:
             self._consecutive = 0
             self._set_state(CLOSED)
+        self._flush_events()
         self._wake.set()
 
     # ------------------------------------------------------------ healer
@@ -163,20 +194,26 @@ class CircuitBreaker:
                     return
                 if self._state == OPEN:
                     self._set_state(HALF_OPEN)
+            self._flush_events()
             ok = False
             try:
                 ok = bool(self.probe()) if self.probe is not None \
                     else True
             except Exception:  # noqa: BLE001 — a failed probe is data
                 ok = False
+            healed = False
             with self._lock:
                 if self._state == HALF_OPEN:
                     if ok:
                         self._consecutive = 0
                         self._set_state(CLOSED)
                         self._healer = None
-                        return
-                    self._opened_at = time.monotonic()
-                    self._set_state(OPEN)
+                        healed = True
+                    else:
+                        self._opened_at = time.monotonic()
+                        self._set_state(OPEN)
                 # OPEN here = re-tripped mid-probe; CLOSED = someone
                 # closed us externally — either way the loop top decides
+            self._flush_events()
+            if healed:
+                return
