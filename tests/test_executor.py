@@ -287,8 +287,8 @@ def test_compile_cache_hits_across_literals():
             aggregations=(SumAggregation("q", "qty", "long"),))
     res1 = r.execute(q("berlin"), TABLE)
     res2 = r.execute(q("chicago"), TABLE)
-    assert res1.metrics["cache_hit"] is False
-    assert res2.metrics["cache_hit"] is True
+    assert res1.metrics["jit_cache_hit"] is False
+    assert res2.metrics["jit_cache_hit"] is True
     assert res2.rows[0]["q"] == DF.qty[DF.city == "chicago"].sum()
     # execute-only time on a cache hit should be far below compile time
     assert res2.metrics["execute_ms"] < res1.metrics["execute_ms"]

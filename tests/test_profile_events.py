@@ -380,7 +380,7 @@ def test_memory_and_compile_metrics_exposed():
     cold = [h for h in eng.history if h.get("recompiles")]
     assert cold and all(h.get("compile_ms", 0) > 0 for h in cold)
     warm = [h for h in eng.history
-            if h.get("cache_hit") and not h.get("recompiles")]
+            if h.get("jit_cache_hit") and not h.get("recompiles")]
     assert warm and all("compile_ms" not in h for h in warm)
 
 

@@ -8,14 +8,19 @@ the gate CI (and future PRs) call:
     python tools/bench_compare.py BASELINE.json NEW.json
     python tools/bench_compare.py BENCH_r05.json BENCH_r06.json \
         --threshold 0.10
+    python tools/bench_compare.py BENCH_CACHE_old.json BENCH_CACHE.json
 
 It compares `detail.per_query_p50_ms` query by query, prints a delta
 table, and exits non-zero when any query's p50 regressed beyond the
-threshold (default 15%). Queries present in only one artifact are
-reported but never gate (a new query is not a regression; a removed one
-is visible in the table). Sub-millisecond baselines are compared with a
-small absolute floor so timer jitter on trivially fast queries cannot
-trip the gate.
+threshold (default 15%). When BOTH artifacts carry the cache bench's
+`detail.cache` block (BENCH_CACHE.json), the table grows a cache-hit-
+rate column and the gate ALSO checks the warm-path p50
+(`per_query_warm_p50_ms`) against the same threshold — a cache that
+stops hitting shows up as a warm regression even when the cold path
+held. Queries present in only one artifact are reported but never gate
+(a new query is not a regression; a removed one is visible in the
+table). Sub-millisecond baselines are compared with a small absolute
+floor so timer jitter on trivially fast queries cannot trip the gate.
 
 Exit codes: 0 ok, 1 regression(s), 2 usage/artifact error.
 """
@@ -37,7 +42,8 @@ def _fail(msg: str):
     raise SystemExit(2)
 
 
-def load_p50(path: str) -> dict:
+def load_artifact(path: str) -> dict:
+    """{"p50": {q: ms}, "warm": {q: ms}|None, "hit_rate": {q: f}|None}"""
     try:
         with open(path) as f:
             doc = json.load(f)
@@ -48,14 +54,28 @@ def load_p50(path: str) -> dict:
               "not an object (truncated/corrupt artifact?)")
     if isinstance(doc.get("parsed"), dict) and "detail" not in doc:
         doc = doc["parsed"]  # driver-banked wrapper (BENCH_rNN.json)
-    per_query = (doc.get("detail") or {}).get("per_query_p50_ms")
+    detail = doc.get("detail") or {}
+    per_query = detail.get("per_query_p50_ms")
     if not isinstance(per_query, dict) or not per_query:
         _fail(f"{path} has no detail.per_query_p50_ms "
               "(not a latency-bench artifact?)")
-    try:
-        return {str(q): float(v) for q, v in per_query.items()}
-    except (TypeError, ValueError) as e:
-        _fail(f"{path}: non-numeric p50 entry: {e}")
+
+    def _floats(d):
+        try:
+            return {str(q): float(v) for q, v in d.items()}
+        except (TypeError, ValueError) as e:
+            _fail(f"{path}: non-numeric p50 entry: {e}")
+
+    out = {"p50": _floats(per_query), "warm": None, "hit_rate": None}
+    cache = detail.get("cache")
+    if isinstance(cache, dict):
+        warm = cache.get("per_query_warm_p50_ms")
+        if isinstance(warm, dict) and warm:
+            out["warm"] = _floats(warm)
+        hr = cache.get("per_query_hit_rate")
+        if isinstance(hr, dict) and hr:
+            out["hit_rate"] = _floats(hr)
+    return out
 
 
 def compare(base: dict, new: dict, threshold: float):
@@ -74,9 +94,10 @@ def compare(base: dict, new: dict, threshold: float):
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
-        description="Compare per-query SSB p50s of two bench artifacts; "
-                    "exit 1 when any query regressed beyond the "
-                    "threshold.")
+        description="Compare per-query SSB p50s of two bench artifacts "
+                    "(cold always; warm-path + hit rate when both are "
+                    "cache-bench artifacts); exit 1 when any query "
+                    "regressed beyond the threshold.")
     p.add_argument("baseline", help="older BENCH_*.json")
     p.add_argument("candidate", help="newer BENCH_*.json")
     p.add_argument(
@@ -87,22 +108,50 @@ def main(argv=None) -> int:
     if not (0.0 <= args.threshold < 100.0):
         p.error(f"--threshold {args.threshold}: must be a fraction >= 0")
 
-    base = load_p50(args.baseline)
-    new = load_p50(args.candidate)
+    base_art = load_artifact(args.baseline)
+    new_art = load_artifact(args.candidate)
+    base, new = base_art["p50"], new_art["p50"]
     rows, only_base, only_new = compare(base, new, args.threshold)
     if not rows:
         print("bench_compare: no queries in common — nothing to gate",
               file=sys.stderr)
         return 2
 
+    have_cache = base_art["warm"] is not None \
+        and new_art["warm"] is not None
+    hit_rates = new_art["hit_rate"] or {}
+
     w = max(len(q) for q, *_ in rows)
-    print(f"{'query':<{w}}  {'base ms':>10}  {'new ms':>10}  "
-          f"{'delta':>8}  gate")
+    hdr = (f"{'query':<{w}}  {'base ms':>10}  {'new ms':>10}  "
+           f"{'delta':>8}")
+    if have_cache:
+        hdr += f"  {'warm ms':>9}  {'wdelta':>8}  {'hit%':>6}"
+    print(hdr + "  gate")
     regressions = []
+    warm_rows = {}
+    if have_cache:
+        wr, _, _ = compare(base_art["warm"], new_art["warm"],
+                           args.threshold)
+        warm_rows = {q: (b, n, d, r) for q, b, n, d, r in wr}
     for q, b, n, delta, regressed in rows:
-        flag = "REGRESSED" if regressed else "ok"
-        print(f"{q:<{w}}  {b:>10.3f}  {n:>10.3f}  {delta:>+7.1%}  {flag}")
+        why = []
         if regressed:
+            why.append("p50")
+        line = f"{q:<{w}}  {b:>10.3f}  {n:>10.3f}  {delta:>+7.1%}"
+        if have_cache:
+            wrow = warm_rows.get(q)
+            if wrow is not None:
+                wb, wn, wd, wreg = wrow
+                if wreg:
+                    why.append("warm")
+                hr = hit_rates.get(q)
+                line += (f"  {wn:>9.3f}  {wd:>+7.1%}  "
+                         f"{hr * 100 if hr is not None else 0:>5.0f}%")
+            else:
+                line += f"  {'-':>9}  {'':>8}  {'':>6}"
+        flag = "REGRESSED(" + ",".join(why) + ")" if why else "ok"
+        print(line + f"  {flag}")
+        if why:
             regressions.append(q)
     for q in only_base:
         print(f"{q:<{w}}  {base[q]:>10.3f}  {'-':>10}  {'':>8}  "
@@ -118,7 +167,9 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 1
     print(f"\nbench_compare: ok ({len(rows)} queries within "
-          f"{args.threshold:.0%})")
+          f"{args.threshold:.0%}"
+          + (", warm path + hit rate checked" if have_cache else "")
+          + ")")
     return 0
 
 

@@ -66,7 +66,7 @@ def main():
     rtt_ms = float(np.percentile(rtts, 50))
 
     keep = ("execute_ms", "lower_ms", "assemble_ms", "result_groups",
-            "result_cap", "packed", "cache_hit", "query_type",
+            "result_cap", "packed", "jit_cache_hit", "query_type",
             "hbm_bytes", "strategy", "pallas")
     prof = {}
     for qname in sorted(QUERIES):

@@ -195,8 +195,15 @@ class Engine:
                            time_column=time_column, star=star,
                            options=dict(options), **pq_fields)
         self.catalog.register(entry)
+        # ingest invalidation (docs/CACHING.md): the fresh TableSegments
+        # took the next generation, orphaning every semantic-cache entry
+        # for this name at key level; purge them eagerly so the byte
+        # budget doesn't stay occupied by unreachable entries
+        self.runner.result_cache.invalidate_table(name)
         self.runner.events.emit(
             "ingest", table=name, accelerated=bool(accelerate),
+            generation=segments.generation if segments is not None
+            else None,
             rows=segments.num_rows if segments is not None else None,
             segments=len(segments.segments) if segments is not None
             else 0)
@@ -545,6 +552,18 @@ class Engine:
             return self._execute_plan(self.planner.plan_stmt(stmt))
 
     def _frame_from(self, plan, res: QueryResult) -> pd.DataFrame:
+        # full-result cache hits carry their entry's live meta dict
+        # (runner._serve_full_cache): memoize the rendered DataFrame on
+        # it — construction dominates the warm-serve wall for small
+        # results. Always hand out copies so a caller mutating the
+        # frame cannot poison the cache. Keyed on the output spec: two
+        # SQL spellings can share one IR entry but project differently.
+        meta = getattr(res, "_cache_meta", None)
+        fkey = tuple((o.name, o.source, o.cast) for o in plan.outputs)
+        if meta is not None:
+            cached = meta.get("frame")
+            if cached is not None and meta.get("frame_key") == fkey:
+                return cached.copy()
         cols = {}
         for o in plan.outputs:
             vals = [r.get(o.source) for r in res.rows]
@@ -554,8 +573,12 @@ class Engine:
                 # naive UTC timestamps, matching pandas semantics
                 vals = pd.to_datetime(vals, utc=True).tz_localize(None)
             cols[o.name] = vals
-        return pd.DataFrame(cols,
-                            columns=[o.name for o in plan.outputs])
+        frame = pd.DataFrame(cols,
+                             columns=[o.name for o in plan.outputs])
+        if meta is not None:
+            meta["frame_key"] = fkey
+            meta["frame"] = frame.copy()
+        return frame
 
     def explain(self, query: str) -> dict:
         """EXPLAIN DRUID REWRITE analog: the chosen QuerySpec (or the
@@ -643,10 +666,22 @@ class Engine:
     # -------------------------------------------------------------- admin
 
     def clear_cache(self, table: str | None = None):
-        """CLEAR DRUID CACHE analog: drop device-resident columns and
-        compiled programs (catalog entries stay registered)."""
+        """CLEAR DRUID CACHE analog: drop device-resident columns,
+        compiled programs, and both semantic result-cache tiers
+        (catalog entries stay registered)."""
         with self.device_lock:
             self.runner.clear_cache(table)
+
+    def drop_table(self, name: str):
+        """DROP the datasource: unregister it and purge every cache that
+        could still serve its data (device buffers, compiled programs,
+        both semantic result-cache tiers). A later re-registration under
+        the same name takes a fresh generation, so even an entry that
+        somehow survived could never be served."""
+        with self.device_lock:
+            self.runner.clear_cache(name)
+        self.catalog.drop(name)
+        self.runner.events.emit("drop", table=name)
 
     @property
     def history(self):

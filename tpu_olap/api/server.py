@@ -24,6 +24,9 @@ equivalent is a JSON-over-HTTP surface (stdlib only, no new deps):
                      ?n= bounds the count)
   GET  /debug/profile  recent traces exported as Chrome-trace JSON —
                      loads directly in Perfetto (?n= bounds traces)
+  GET  /debug/cache  semantic result-cache state: per-tier entries/
+                     bytes/hits/misses/evictions + per-table ingest
+                     generations (docs/CACHING.md)
   POST /debug/profile?ms=N
                      on-demand jax.profiler capture for N ms (capped);
                      dispatches inside the window are annotated with
@@ -323,6 +326,18 @@ class QueryServer:
             n = _int_param(_parse_query(path), ("n", "limit"),
                            cap=self.engine.tracer.ring_limit)
             return chrome_trace(self.engine.tracer.recent_traces(n))
+        if path == "/debug/cache" or path.startswith("/debug/cache?"):
+            # semantic result-cache state (executor.resultcache;
+            # docs/CACHING.md): per-tier entries/bytes/hit counters plus
+            # each accelerated table's live ingest generation — the key
+            # component that invalidates both tiers
+            eng = self.engine
+            snap = eng.runner.result_cache.snapshot()
+            snap["generations"] = {
+                n: eng.catalog.get(n).segments.generation
+                for n in eng.catalog.names()
+                if eng.catalog.get(n).is_accelerated}
+            return snap
         raise KeyError(f"unknown path {path!r}")
 
     def _get_metrics(self) -> str:

@@ -92,6 +92,14 @@ def run_batch(runner, queries, table, query_ids=None) -> list:
     singles, fused = [], []   # [(query, duplicate indexes, plan)]
     for idxs in uniq.values():
         q = queries[idxs[0]]
+        # batch legs consult the same full-result tier as single-query
+        # dispatch: a cached leg is served (and fanned out to its
+        # duplicates) without lowering, fusing, or touching the device
+        with use_query_id(query_ids[idxs[0]] or None):
+            cached = runner._serve_full_cache(q, table)
+        if cached is not None:
+            _fan_out(runner, boxed, cached, idxs, queries, query_ids)
+            continue
         try:
             plan = runner._lower_cached(q, table)
             reason = fusable(plan, runner.mesh) \
@@ -285,7 +293,7 @@ def _run_fused(runner, table, group, query_ids=None):
             # build to the first leg's record (counting it on every leg
             # would multiply one compile by batch_legs in /metrics)
             runner._note_compile("batch", metrics_list[0])
-        ssp.set(cache_hit=hit, scan_ms_shared=round(shared_ms, 3))
+        ssp.set(jit_cache_hit=hit, scan_ms_shared=round(shared_ms, 3))
 
         results = []
         for leg_i, ((q, idxs, plan), m, partials, leg_ms) in enumerate(
@@ -305,12 +313,15 @@ def _run_fused(runner, table, group, query_ids=None):
                 res = runner._assemble_agg(q, plan, arrays)
             m["scan_ms_shared"] = shared_ms
             m["agg_ms"] = leg_ms
-            m["cache_hit"] = hit
+            m["jit_cache_hit"] = hit
             m["num_shards"] = 1
             m["assemble_ms"] = (time.perf_counter() - t0) * 1000
             m["total_ms"] = (time.perf_counter() - t_start) * 1000
             res.metrics = m
             runner.record(m)
+            # fused legs populate the same full-result tier the
+            # single-query path serves from (docs/CACHING.md)
+            runner._store_full_cache(q, table, res)
             lsp.set(query_id=m["query_id"], query_type=m["query_type"],
                     agg_ms=round(leg_ms, 3), duplicates=len(idxs))
             results.append(res)

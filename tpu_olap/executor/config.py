@@ -130,6 +130,29 @@ class EngineConfig:
     # GIL on large array ops): 0 = auto (min(4, cores)), 1 = serial
     batch_cpu_threads: int = 0
 
+    # --- semantic result caching (executor.resultcache; docs/CACHING.md)
+    # Tier 2: bounded LRU full-result cache keyed by (normalized query
+    # JSON, table generation) — the broker result cache. Tier 1:
+    # per-segment partial-aggregate cache keyed by (generation, segment
+    # id, query template minus intervals) — the historical cache: a
+    # repeated aggregate over a moving window recomputes only uncached
+    # segments in one device pass and merges the rest host-side via the
+    # aggregators' merge semantics. Both invalidate generationally on
+    # ingest/DROP and clear with CLEAR DRUID CACHE. Off by default:
+    # serving deployments opt in; benches/tests that measure raw compute
+    # rely on every execution dispatching.
+    result_cache_enabled: bool = False
+    result_cache_max_bytes: int = 256 << 20
+    segment_cache_enabled: bool = False
+    segment_cache_max_bytes: int = 512 << 20
+    # segments with fewer valid rows than this floor are recomputed
+    # rather than cached (per-entry overhead beats the recompute win)
+    segment_cache_min_rows: int = 256
+    # max total per-segment state elements (segments x groups x agg
+    # radix) the one-pass per-segment dispatch may allocate; plans past
+    # it bypass tier 1 (the plain packed/partials path serves them)
+    segment_cache_state_budget: int = 1 << 22
+
     # execution platform: "device" = default jax backend, "cpu" = numpy path
     platform: str = "device"
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import functools
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -121,12 +122,26 @@ def sanitize_metric_value(v, _depth=0):
 
 
 def _evict_one(cache: dict) -> None:
-    """FIFO-evict one entry, tolerating the abandoned-deadline-thread
-    concurrency (_run_with_deadline): a concurrent insert between iter()
-    and next() raises RuntimeError, a concurrent pop raises KeyError —
-    either just means someone else made room."""
+    """Evict the LEAST-RECENTLY-USED entry: the runner caches are
+    OrderedDicts whose hits move-to-end (_cache_lru_hit), so the first
+    key is the coldest — previously this popped an arbitrary first
+    entry, which under insertion order is plain FIFO and evicts hot
+    compiled templates during churn. Tolerates the abandoned-deadline-
+    thread concurrency (_run_with_deadline): a concurrent insert between
+    iter() and next() raises RuntimeError, a concurrent pop raises
+    KeyError — either just means someone else made room."""
     try:
         cache.pop(next(iter(cache), None), None)
+    except (KeyError, RuntimeError):
+        pass
+
+
+def _cache_lru_hit(cache, key) -> None:
+    """Mark a cache hit for LRU eviction: move the key to the
+    OrderedDict's end, tolerating concurrent mutation by an abandoned
+    deadline thread (a vanished key is just a racing purge)."""
+    try:
+        cache.move_to_end(key)
     except (KeyError, RuntimeError):
         pass
 
@@ -152,10 +167,14 @@ class QueryRunner:
         self._datasets: dict = {}
         from tpu_olap.executor.dataset import HbmLedger
         self._hbm_ledger = HbmLedger(self.config.hbm_budget_bytes)
-        self._jit_cache: dict = {}
-        self._arg_cache: dict = {}   # uploaded consts/seg-mask, content-keyed
+        # OrderedDicts so eviction is LRU: hits move-to-end
+        # (_cache_lru_hit), _evict_one pops the coldest entry
+        self._jit_cache: OrderedDict = OrderedDict()
+        self._arg_cache: OrderedDict = OrderedDict()  # uploaded consts/
+        #                                  seg-mask, content-keyed
         self._cap_hints: dict = {}   # template -> last observed group count
-        self._plan_cache: dict = {}  # lowered PhysicalPlans, per query JSON
+        self._plan_cache: OrderedDict = OrderedDict()  # lowered
+        #                                  PhysicalPlans, per query JSON
         self._mesh = None
         self._active_shards = config.num_shards if config else None
         self._last_metrics: dict = {}
@@ -253,6 +272,13 @@ class QueryRunner:
             self.config.breaker_failure_threshold,
             self.config.breaker_open_cooldown_s,
             probe=self._healer_probe, metrics=m, events=self.events)
+        # two-tier semantic result cache (executor.resultcache;
+        # docs/CACHING.md): tier 2 full results consulted at execute()
+        # entry, tier 1 per-segment partials consulted inside _run_agg —
+        # both generation-invalidated, cleared by clear_cache
+        from tpu_olap.executor.resultcache import ResultCache
+        self.result_cache = ResultCache(self.config, metrics=m,
+                                        events=self.events)
         self._attempt_local = threading.local()  # host-transfer inject
 
     def _inject(self, stage: str):
@@ -273,6 +299,12 @@ class QueryRunner:
             if m.get("fallback_breaker"):
                 return "fallback_breaker"
             return "fallback"
+        if m.get("cache_tier") == "full":
+            # served wholly from the full-result cache: no dispatch ran,
+            # so none of the execution-flavor labels apply (tier-1
+            # partial hits keep their real dispatch path — a device pass
+            # still computed the uncached segments)
+            return "cache"
         if m.get("batch_dedup") or m.get("batch_legs", 0) > 1:
             return "batch"
         if m.get("sparse"):
@@ -290,7 +322,7 @@ class QueryRunner:
         append to the bounded history ring. Sanitization is IN PLACE so
         a QueryResult.metrics dict sharing this object stays the
         consistent view."""
-        had_cache_key = "cache_hit" in m
+        had_jit_key = "jit_cache_hit" in m
         for k in list(m):
             m[k] = sanitize_metric_value(m[k])
         m.setdefault("query_id",
@@ -328,9 +360,9 @@ class QueryRunner:
                                 query_type=qt, path=path)
         self._m_rows.inc(m["rows_scanned"] or 0)
         self._m_segments.inc(m["segments_scanned"] or 0)
-        if had_cache_key:
+        if had_jit_key:
             self._m_compile.inc(
-                result="hit" if m["cache_hit"] else "miss")
+                result="hit" if m["jit_cache_hit"] else "miss")
         if m.get("retries"):
             self._m_retries.inc(m["retries"])
         if m.get("deadline_exceeded"):
@@ -394,6 +426,8 @@ class QueryRunner:
                 path=path, datasource=m["datasource"],
                 total_ms=round(m["total_ms"] or 0.0, 3),
                 cache_hit=bool(m["cache_hit"]),
+                **({"cache_tier": m["cache_tier"]}
+                   if m.get("cache_tier") else {}),
                 **({"failed": True} if failed else {}))
         self.history.append(m)
         return m
@@ -441,6 +475,7 @@ class QueryRunner:
         self._m_cache_entries.set(len(self._jit_cache), cache="jit")
         self._m_cache_entries.set(len(self._plan_cache), cache="plan")
         self._m_cache_entries.set(len(self._arg_cache), cache="arg")
+        self.result_cache._refresh_gauges()
 
     def counters(self) -> dict:
         """Aggregate counters, maintained incrementally at record time —
@@ -567,7 +602,13 @@ class QueryRunner:
             name="tpu-olap-batch-dispatch")
 
     def execute(self, query, table) -> QueryResult:
-        # breaker first: while open, fail in microseconds (the engine
+        # full-result cache first: a hit needs no admission slot, no
+        # dispatch lock, and no healthy device — it keeps serving
+        # repeated queries through breaker-open windows and overload
+        res = self._serve_full_cache(query, table)
+        if res is not None:
+            return res
+        # breaker next: while open, fail in microseconds (the engine
         # routes fallback-capable queries to the interpreter) instead of
         # queueing doomed work onto the sick device
         self.breaker.check()
@@ -766,7 +807,58 @@ class QueryRunner:
         res.metrics["datasource"] = table.name
         if abandoned is None or not abandoned.is_set():
             self.record(res.metrics)
+            self._store_full_cache(query, table, res)
         return res
+
+    # --------------------------------------------- semantic result cache
+
+    _CACHEABLE_QUERY_TYPES = ("timeseries", "groupBy", "topN")
+
+    def _serve_full_cache(self, query, table) -> QueryResult | None:
+        """Tier-2 lookup (docs/CACHING.md): a hit returns a fresh
+        QueryResult sharing the cached rows, with a real observability
+        record (cache_hit=True, cache_tier="full", path="cache",
+        rows_scanned=0). None = miss/bypass, caller executes."""
+        rc = self.result_cache
+        if not rc.full_enabled \
+                or getattr(query, "query_type", None) \
+                not in self._CACHEABLE_QUERY_TYPES \
+                or getattr(table, "generation", None) is None:
+            return None
+        t0 = time.perf_counter()
+        with _span("result-cache") as sp:
+            hit = rc.get_full(query, table)
+            sp.set(tier="full", hit=hit is not None)
+        if hit is None:
+            return None
+        rows, druid, meta = hit
+        m = {"query_type": query.query_type, "datasource": table.name,
+             "cache_hit": True, "cache_tier": "full",
+             "rows_scanned": 0, "segments_scanned": 0,
+             "segments_total": meta.get("segments_total", 0),
+             "rows_returned": len(rows),
+             "total_ms": (time.perf_counter() - t0) * 1000}
+        res = QueryResult(query, rows, druid, m)
+        # the entry's live meta dict rides along so the SQL layer can
+        # memoize its rendered DataFrame on the entry
+        # (Engine._frame_from): frame construction is over half the
+        # warm-serve wall for small results
+        res._cache_meta = meta
+        self.record(m)
+        return res
+
+    def _store_full_cache(self, query, table, res: QueryResult):
+        """Populate tier 2 from a successfully served result (single
+        path, batch singles, and fused batch legs all funnel here)."""
+        rc = self.result_cache
+        if not rc.full_enabled \
+                or getattr(query, "query_type", None) \
+                not in self._CACHEABLE_QUERY_TYPES \
+                or getattr(table, "generation", None) is None \
+                or res.metrics.get("failed"):
+            return
+        rc.put_full(query, table, res.rows, res.druid, {
+            "segments_total": res.metrics.get("segments_total", 0)})
 
     def _lower_cached(self, query, table):
         """Memoized lower(): re-lowering an unchanged query template
@@ -794,6 +886,7 @@ class QueryRunner:
                c.pallas_auto_flop_budget)
         hit = self._plan_cache.get(key)
         if hit is not None and hit[0] is table:
+            _cache_lru_hit(self._plan_cache, key)
             return hit[1]
         plan = lower(query, table, self.config)
         if len(self._plan_cache) > 512:
@@ -822,11 +915,14 @@ class QueryRunner:
         """Evict device-resident columns (+ compiled programs if full clear).
         The analog of `CLEAR DRUID CACHE` (SURVEY.md §4.5)."""
         self._m_cache_clears.inc(scope="table" if table_name else "full")
+        purged = self.result_cache.clear(table_name)
         self.events.emit(
             "cache_clear", table=table_name or "*",
             jit_entries=len(self._jit_cache),
             plan_entries=len(self._plan_cache),
-            arg_entries=len(self._arg_cache))
+            arg_entries=len(self._arg_cache),
+            result_entries=purged["full"],
+            segment_entries=purged["segment"])
         # list() snapshots: an abandoned deadline thread may insert
         # concurrently (see _run_with_deadline) — never iterate live dicts
         if table_name is None:
@@ -839,17 +935,19 @@ class QueryRunner:
             self._plan_cache.clear()
         elif table_name in self._datasets:
             self._datasets.pop(table_name).evict()
-            self._jit_cache = {k: v for k, v in list(self._jit_cache.items())
-                               if k[0] != table_name}
-            self._arg_cache = {k: v for k, v in list(self._arg_cache.items())
-                               if k[0] != table_name}
+            self._jit_cache = OrderedDict(
+                (k, v) for k, v in list(self._jit_cache.items())
+                if k[0] != table_name)
+            self._arg_cache = OrderedDict(
+                (k, v) for k, v in list(self._arg_cache.items())
+                if k[0] != table_name)
             self._cap_hints = {k: v for k, v in list(self._cap_hints.items())
                                if k[0] != table_name}
             # plans pin their TableSegments (host column arrays): drop
             # them too or a re-registration keeps the old data alive
-            self._plan_cache = {k: v for k, v
-                                in list(self._plan_cache.items())
-                                if k[0] != table_name}
+            self._plan_cache = OrderedDict(
+                (k, v) for k, v in list(self._plan_cache.items())
+                if k[0] != table_name)
 
     # ------------------------------------------------------------- dispatch
 
@@ -1054,14 +1152,14 @@ class QueryRunner:
 
         if self.config.platform == "cpu":
             t0 = time.perf_counter()
-            with _span("dispatch", cache_hit=False, num_shards=1):
+            with _span("dispatch", jit_cache_hit=False, num_shards=1):
                 if win is not None:
                     env, valid, seg_mask = self._window_numpy(
                         env, np.asarray(valid), seg_mask, win)
                 out = plan.kernel(env, np.asarray(valid), seg_mask,
                                   plan.pool.consts)
             metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
-            metrics["cache_hit"] = False
+            metrics["jit_cache_hit"] = False
             metrics["num_shards"] = 1
             return _embed_mask({k: np.asarray(v) for k, v in out.items()})
 
@@ -1071,7 +1169,9 @@ class QueryRunner:
             + ((win[1],) if win else ())
         jitted = self._jit_cache.get(key)
         hit = jitted is not None
-        if not hit:
+        if hit:
+            _cache_lru_hit(self._jit_cache, key)
+        else:
             if mesh is not None:
                 from tpu_olap.executor.sharding import sharded_kernel
                 jitted = jax.jit(sharded_kernel(plan, mesh))
@@ -1082,7 +1182,7 @@ class QueryRunner:
             self._jit_cache[key] = jitted
             self._note_compile("partials", metrics)
         t0 = time.perf_counter()
-        with _span("dispatch", cache_hit=hit,
+        with _span("dispatch", jit_cache_hit=hit,
                    num_shards=mesh.devices.size if mesh else 1):
             consts_dev, seg_arg = self._args_for(plan, seg_mask, mesh)
             out = jitted(env, valid, seg_arg, consts_dev, win[0]) \
@@ -1094,7 +1194,7 @@ class QueryRunner:
             self._inject("host-transfer")
             out = {k: np.asarray(v) for k, v in out.items()}
         metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
-        metrics["cache_hit"] = hit
+        metrics["jit_cache_hit"] = hit
         metrics["num_shards"] = mesh.devices.size if mesh else 1
         return _embed_mask(out)
 
@@ -1113,6 +1213,7 @@ class QueryRunner:
                 mesh.devices.size if mesh else 0)
         hit = self._arg_cache.get(ckey)
         if hit is not None:
+            _cache_lru_hit(self._arg_cache, ckey)
             return hit
         if mesh is not None:
             from tpu_olap.executor.sharding import replicate_put, shard_put
@@ -1141,6 +1242,8 @@ class QueryRunner:
                                     mesh.devices.size if mesh else 1) \
             + ((win[1],) if win else ())
         jitted = self._jit_cache.get(key)
+        if jitted is not None:
+            _cache_lru_hit(self._jit_cache, key)
         if jitted is None:
             if mesh is not None and strategy == "historicals":
                 from tpu_olap.executor.sharding import sharded_kernel
@@ -1201,15 +1304,15 @@ class QueryRunner:
                     break
                 if count > cap_limit:
                     metrics["result_groups"] = count
-                    metrics["cache_hit"] = hit
-                    dsp.set(cache_hit=hit, overflow=True)
+                    metrics["jit_cache_hit"] = hit
+                    dsp.set(jit_cache_hit=hit, overflow=True)
                     return None  # config cap exceeded: unpacked re-run
                 cap = min(cap_limit, _next_pow2(count))
-            dsp.set(cache_hit=hit,
+            dsp.set(jit_cache_hit=hit,
                     num_shards=mesh.devices.size if mesh else 1)
         self._cap_hints[base_key] = count
         metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
-        metrics["cache_hit"] = hit
+        metrics["jit_cache_hit"] = hit
         metrics["num_shards"] = mesh.devices.size if mesh else 1
         metrics["result_groups"] = count
         metrics["result_cap"] = layout.cap
@@ -1226,7 +1329,7 @@ class QueryRunner:
         slot tables (SENTINEL-keyed empties), others are [cap] compacts."""
         with _span("dispatch", sparse=True) as sp:
             out = self._run_sparse_inner(plan, metrics)
-            sp.set(cache_hit=metrics.get("cache_hit"),
+            sp.set(jit_cache_hit=metrics.get("jit_cache_hit"),
                    result_groups=metrics.get("result_groups"),
                    num_shards=metrics.get("num_shards"))
         return out
@@ -1279,7 +1382,9 @@ class QueryRunner:
                 key = base_key + (cap,) + ((win[1],) if win else ())
                 jitted = self._jit_cache.get(key)
                 hit = jitted is not None
-                if not hit:
+                if hit:
+                    _cache_lru_hit(self._jit_cache, key)
+                else:
                     kern = plan.make_sparse_kernel(cap)
                     if mesh is not None:
                         from tpu_olap.executor.sharding import \
@@ -1321,7 +1426,9 @@ class QueryRunner:
                 key = base_key + ("x", cap, cap_owner)
                 jitted = self._jit_cache.get(key)
                 hit = jitted is not None
-                if not hit:
+                if hit:
+                    _cache_lru_hit(self._jit_cache, key)
+                else:
                     kern = plan.make_sparse_kernel(cap)
                     jitted = jax.jit(sharded_sparse_exchange_kernel(
                         kern, plan, mesh, cap, cap_owner))
@@ -1360,7 +1467,7 @@ class QueryRunner:
             metrics["result_cap_owner"] = cap_owner
         self._cap_hints[base_key] = count
         metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
-        metrics["cache_hit"] = hit
+        metrics["jit_cache_hit"] = hit
         metrics["sparse"] = True
         metrics["result_groups"] = count
         metrics["result_cap"] = cap
@@ -1405,6 +1512,19 @@ class QueryRunner:
             metrics["assemble_ms"] = (time.perf_counter() - t0) * 1000
             return res
 
+        if self.result_cache.seg_enabled:
+            arrays = self._run_agg_segcached(query, plan, metrics, specs,
+                                             keep_raw, table)
+            if arrays is not None:
+                t0 = time.perf_counter()
+                with _span("post-agg"):
+                    eval_post_aggs(arrays, query.post_aggregations)
+                with _span("assemble"):
+                    res = self._assemble_agg(query, plan, arrays)
+                res.metrics = metrics
+                metrics["assemble_ms"] = (time.perf_counter() - t0) * 1000
+                return res
+
         packed = None
         if self.config.platform != "cpu" and not keep_raw:
             packed = self._dispatch(
@@ -1436,6 +1556,176 @@ class QueryRunner:
         res.metrics = metrics
         metrics["assemble_ms"] = (time.perf_counter() - t0) * 1000
         return res
+
+    def _run_agg_segcached(self, query, plan, metrics, specs, keep_raw,
+                           table):
+        """Tier-1 per-segment partial-aggregate path (docs/CACHING.md):
+        serve every cached, fully-interval-covered segment from the
+        cache, recompute the rest in ONE device pass that keys the
+        group space by (segment, group) so each computed segment's
+        partials come back separately (cacheable), then fold everything
+        on the host via the aggregators' merge semantics and finalize.
+        Returns finalized arrays, or None when the plan bypasses the
+        tier (the caller falls through to the packed/partials paths).
+        The bypass reason and per-segment decision are stamped on the
+        record and the `segment-cache` span (EXPLAIN ANALYZE shows
+        them)."""
+        import functools as _ft
+
+        from tpu_olap.kernels.groupby import merge_partials
+
+        rc = self.result_cache
+        reason = rc.tier1_bypass_reason(plan, self.mesh)
+        if reason is not None:
+            metrics["segment_cache"] = f"bypass: {reason}"
+            rc.count_bypass()
+            return None
+        intervals = query.intervals or (ETERNITY,)
+        tkey = rc.template_key(query, table)
+        floor = max(0, int(self.config.segment_cache_min_rows))
+        covered, always_compute = [], []
+        for sid in plan.pruned_ids:
+            sm = table.segments[sid].meta
+            # only segments ENTIRELY inside one query interval have
+            # interval-independent partials; straddlers (and sub-floor
+            # segments, where entry overhead beats the recompute win)
+            # are computed fresh every time and never stored
+            if sm.n_valid >= floor and any(
+                    iv.start <= sm.time_min and iv.end > sm.time_max
+                    for iv in intervals):
+                covered.append(sid)
+            else:
+                always_compute.append(sid)
+        with _span("segment-cache") as sp:
+            hits = rc.get_segments(tkey, table, plan, covered)
+            to_compute = sorted(
+                [s for s in covered if s not in hits] + always_compute)
+            sp.set(segments_cached=len(hits),
+                   segments_computed=len(to_compute),
+                   segments_uncovered=len(always_compute))
+            if to_compute:
+                fresh = self._dispatch(
+                    lambda: self._run_seg_partials(plan, metrics,
+                                                   to_compute),
+                    metrics, table.name)
+                storable = set(covered)
+                for sid in to_compute:
+                    if sid in storable:
+                        rc.put_segment(tkey, table, plan, sid, fresh[sid])
+            else:
+                fresh = {}
+                metrics["segments_total"] = len(table.segments)
+                metrics["segments_scanned"] = 0
+                metrics["rows_scanned"] = 0
+                metrics["num_shards"] = 1
+        metrics["cache_hit"] = bool(hits)
+        if hits:
+            metrics["cache_tier"] = "segment"
+        metrics["segments_cached"] = len(hits)
+        metrics["segments_computed"] = len(to_compute)
+        parts = [hits[s] if s in hits else fresh[s]
+                 for s in sorted(set(covered) | set(always_compute))]
+        merged = _ft.reduce(
+            lambda a, b: merge_partials(a, b, plan.agg_plans), parts)
+        with _span("finalize"):
+            return finalize_aggs(merged, plan.agg_plans, specs, keep_raw)
+
+    def _run_seg_partials(self, plan: PhysicalPlan, metrics: dict,
+                          compute_ids: list) -> dict:
+        """One pass computing PER-SEGMENT partials for `compute_ids`:
+        the plan's key_fn front half runs over a window covering the
+        segments, the group key is extended to (local segment, group),
+        and one group_reduce over W*K groups yields every segment's own
+        mergeable partials dict ({segment id: partials}). One compiled
+        program per (template, W) serves ANY to-compute subset — the
+        subset rides in through the seg-mask runtime argument."""
+        env, valid, _ = self._prepare(plan, metrics)
+        table = plan.table
+        ds = self._dataset(table)
+        seg_mask = ds.segment_mask(compute_ids)
+        # honest scan accounting: only the computed segments are read
+        metrics["segments_scanned"] = len(compute_ids)
+        metrics["rows_scanned"] = int(sum(
+            table.segments[i].meta.n_valid for i in compute_ids))
+        S = len(seg_mask)
+        K = plan.total_groups
+        lo, hi = min(compute_ids), max(compute_ids) + 1
+        t0 = time.perf_counter()
+        if self.config.platform == "cpu":
+            W = hi - lo
+            with _span("dispatch", jit_cache_hit=False, segcache=True,
+                       num_shards=1):
+                wenv, wvalid, wmask = self._window_numpy(
+                    env, np.asarray(valid), seg_mask, (lo, W))
+                fenv, mask, key = plan.key_fn(wenv, wvalid, wmask,
+                                              plan.pool.consts)
+                from tpu_olap.kernels.groupby import group_reduce
+                r = mask.size // W
+                key2 = (np.repeat(np.arange(W, dtype=np.int64), r)
+                        * K + key.astype(np.int64))
+                out = group_reduce(key2, mask, fenv, plan.agg_plans,
+                                   W * K, plan.pool.consts)
+            out = {k: np.asarray(v) for k, v in out.items()}
+            metrics["jit_cache_hit"] = False
+            metrics["num_shards"] = 1
+        else:
+            import jax
+            W = min(_next_pow2(hi - lo), S)
+            lo = min(lo, S - W)
+            jkey = plan.fingerprint() + ("segcache", W)
+            jitted = self._jit_cache.get(jkey)
+            hit = jitted is not None
+            if hit:
+                _cache_lru_hit(self._jit_cache, jkey)
+            else:
+                jitted = jax.jit(
+                    self._seg_partials_kernel(plan, W, K))
+                self._jit_cache[jkey] = jitted
+                self._note_compile("segcache", metrics)
+            with _span("dispatch", jit_cache_hit=hit, segcache=True,
+                       num_shards=1):
+                consts_dev, seg_arg = self._args_for(plan, seg_mask,
+                                                     None)
+                out = jitted(env, valid, seg_arg, consts_dev, lo)
+            with _span("host-transfer"):
+                self._inject("host-transfer")
+                out = {k: np.asarray(v) for k, v in out.items()}
+            metrics["jit_cache_hit"] = hit
+            metrics["num_shards"] = 1
+        metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
+        shaped = {name: arr.reshape((W, K) + arr.shape[1:])
+                  for name, arr in out.items()}
+        return {sid: {name: arr[sid - lo]
+                      for name, arr in shaped.items()}
+                for sid in compute_ids}
+
+    @staticmethod
+    def _seg_partials_kernel(plan: PhysicalPlan, W: int, K: int):
+        """fn(env, valid, seg_mask, consts, lo): window-slice every
+        [S, ...] input to [W, ...], run the plan's filter/dim front
+        half, extend the key by the local segment index, reduce over
+        W*K groups. `lo` is traced, so a sliding to-compute window of
+        the same width re-uses the executable. The int32 key is safe:
+        tier1_bypass_reason rejects plans whose segment-extended key
+        space reaches 2^31."""
+        import jax
+        import jax.numpy as jnp
+
+        from tpu_olap.kernels.groupby import group_reduce
+
+        def fn(env, valid, seg_mask, consts, lo):
+            def sl(a):
+                return jax.lax.dynamic_slice_in_dim(a, lo, W, axis=0)
+            wenv = {"cols": {c: sl(a) for c, a in env["cols"].items()},
+                    "nulls": {c: sl(a) for c, a in env["nulls"].items()}}
+            fenv, mask, key = plan.key_fn(wenv, sl(valid), sl(seg_mask),
+                                          consts)
+            r = mask.shape[0] // W
+            seg_local = jnp.repeat(jnp.arange(W, dtype=jnp.int32), r)
+            key2 = seg_local * jnp.int32(K) + key.astype(jnp.int32)
+            return group_reduce(key2, mask, fenv, plan.agg_plans, W * K,
+                                consts)
+        return fn
 
     def _assemble_agg(self, query, plan, arrays) -> QueryResult:
         """Final-arrays -> QueryResult by query type. Shared tail of the

@@ -17,6 +17,25 @@ from tpu_olap.segments.dictionary import Dictionary
 
 TIME_COLUMN = "__time"
 
+# per-table-name ingest generation (the Druid segment-version analog):
+# every TableSegments construction takes the next value, so ingest and
+# re-registration orphan all semantic-cache entries for that table at
+# key level (executor.resultcache) — a stale generation can never be
+# served even before the eager purge runs. Module-global on purpose:
+# two engines registering the same name in one process must not reuse
+# generations against each other.
+import threading as _threading
+
+_GEN_LOCK = _threading.Lock()
+_GENERATIONS: dict = {}
+
+
+def next_table_generation(name: str) -> int:
+    with _GEN_LOCK:
+        g = _GENERATIONS.get(name, 0) + 1
+        _GENERATIONS[name] = g
+        return g
+
 
 class ColumnType(enum.Enum):
     STRING = "STRING"  # dict-encoded int32 codes (0 = null)
@@ -69,6 +88,11 @@ class TableSegments:
         self.dictionaries = dictionaries  # col -> Dictionary (STRING cols)
         self.segments = segments        # list[Segment], time-ordered
         self.block_rows = block_rows
+        # ingest generation: part of every semantic-cache key, bumped by
+        # construction (each ingest/re-registration builds a fresh
+        # TableSegments), so cached results can never outlive the data
+        # they were computed from (docs/CACHING.md)
+        self.generation = next_table_generation(name)
         # declared star schema (set at registration when provided):
         # lowering consults its functional dependencies for data-derived
         # dimension-domain restriction (filter on a dependent column
