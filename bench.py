@@ -250,8 +250,11 @@ def _fault_overhead(eng, iters: int, note):
 
 
 def main(span_summary: bool = False, inject_faults: int | None = None,
-         trace_out: str | None = None):
-    eng, ctx = _setup()
+         trace_out: str | None = None,
+         pipeline_depth: int | None = None):
+    eng, ctx = _setup(
+        {} if pipeline_depth is None
+        else {"pipeline_depth": pipeline_depth})
     note = ctx["note"]
     backend, rows, iters = ctx["backend"], ctx["rows"], ctx["iters"]
     tpu_unavailable, use_pallas = ctx["tpu_unavailable"], ctx["use_pallas"]
@@ -795,6 +798,12 @@ def _parse_args(argv=None):
              "execution; banks per-query faulted p50 and the recovery "
              "overhead (faulted minus clean) into the BENCH json "
              "detail as fault_injection (docs/RESILIENCE.md)")
+    p.add_argument(
+        "--pipeline-depth", type=int, default=None, metavar="N",
+        help="override EngineConfig.pipeline_depth for the latency "
+             "bench (0 = serialized dispatch; default = engine "
+             "default). The concurrency A/B lives in "
+             "tools/bench_concurrency.py")
     args = p.parse_args(argv)
     if args.concurrency is not None and args.trace_out:
         p.error("--trace-out only applies to the latency bench; it is "
@@ -814,4 +823,4 @@ if __name__ == "__main__":
     if args.concurrency is not None:
         sys.exit(_concurrency_main(args.concurrency))
     main(span_summary=args.span_summary, inject_faults=args.inject_faults,
-         trace_out=args.trace_out)
+         trace_out=args.trace_out, pipeline_depth=args.pipeline_depth)

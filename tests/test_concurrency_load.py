@@ -95,6 +95,64 @@ def test_no_class_starves_under_mixed_load(served_engine):
     assert len(eng.history) >= len(by_class["grouped"])
 
 
+def test_pipelined_16_thread_mixed_class_parity():
+    """ISSUE 10: with pipelined execution (pipeline_depth >= 2, the
+    default) 16 threads of mixed classes — device GROUP BY, device
+    global agg, pandas fallback, planner-only statement — hammer one
+    engine directly. Every response must be frame-identical to the
+    single-threaded reference: the enqueue-only lock scope must not
+    let stage-2 completions cross-contaminate plans, caches, records,
+    or results."""
+    rng = np.random.default_rng(31)
+    rows = 20_000
+    df = pd.DataFrame({
+        "ts": pd.to_datetime("2024-01-01")
+        + pd.to_timedelta(rng.integers(0, 86400 * 30, rows), unit="s"),
+        "g": rng.choice([f"g{i}" for i in range(32)], rows),
+        "v": rng.integers(0, 1000, rows).astype(np.int64),
+    })
+    eng = Engine(EngineConfig(pipeline_depth=2))
+    eng.register_table("t", df, time_column="ts", block_rows=1 << 12)
+    # deterministic-response classes only (EXPLAIN output includes no
+    # frame to compare, so the statement class checks shape instead)
+    ref = {lb: eng.sql(sql) for lb, sql in CLASSES.items()}
+    h0 = len(eng.runner.history)
+
+    errs: list = []
+    stop = threading.Event()
+
+    def client(label):
+        sql = CLASSES[label]
+        while not stop.is_set():
+            try:
+                out = eng.sql(sql)
+                if label == "statement":
+                    if list(out.columns) != list(ref[label].columns):
+                        errs.append((label, "columns drifted"))
+                elif not out.equals(ref[label]):
+                    errs.append((label, "frame mismatch"))
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errs.append((label, repr(e)))
+
+    labels = list(CLASSES)
+    threads = [threading.Thread(target=client, args=(labels[i % 4],),
+                                daemon=True) for i in range(16)]
+    for t in threads:
+        t.start()
+    time.sleep(2.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=90)
+    assert not errs, errs[:5]
+    # the device classes really rode the pipelined path
+    piped = [m for m in eng.runner.history[h0:] if m.get("pipelined")]
+    assert piped, "no pipelined records under mixed load"
+    # all in-flight accounting drained
+    snap = eng.runner.admission.snapshot()
+    assert snap["pipeline_inflight"] == 0
+    assert eng.runner._hbm_ledger.inflight_bytes == 0
+
+
 def test_coalescing_window_batches_concurrent_queries():
     """batch_window_ms > 0: concurrent execute() callers ride ONE
     shared-scan dispatch (executor.batch.Coalescer) — identical

@@ -1,0 +1,346 @@
+"""Pipelined query execution (ISSUE 10): the enqueue-only dispatch
+lock. Stage 1 (enqueue, under dispatch_lock) fires the async device
+program and pins the result buffers in the HbmLedger; stage 2
+(complete, lock-free) transfers, finalizes, and assembles on the
+caller's thread. These tests pin the stage split's contracts: ledger
+pinning vs eviction, deadline expiry during a stage-2 transfer,
+breaker trips between enqueue and complete, result-cache population
+from a stage-2 completion, the pipeline-occupancy bound, and the new
+observability surface (dispatch_lock_wait_ms, pipelined flag)."""
+
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpu_olap import Engine
+from tpu_olap.executor import EngineConfig
+from tpu_olap.executor.dataset import HbmLedger
+from tpu_olap.resilience import QueryShed
+from tpu_olap.resilience.admission import AdmissionController
+
+
+def _df(n=4096, seed=9):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "ts": pd.to_datetime("2021-01-01")
+        + pd.to_timedelta(rng.integers(0, 86400 * 30, n), unit="s"),
+        "g": rng.choice(["x", "y", "z"], n),
+        "v": rng.integers(0, 100, n).astype(np.int64),
+    })
+
+
+SQL = "SELECT g, sum(v) AS s, count(*) AS n FROM t GROUP BY g ORDER BY g"
+
+
+def _register(eng, **kw):
+    eng.register_table("t", _df(), time_column="ts", block_rows=512,
+                       **kw)
+
+
+def _reference():
+    ref = Engine(EngineConfig(pipeline_depth=0))
+    _register(ref)
+    return ref.sql(SQL)
+
+
+# ------------------------------------------------------------- basics
+
+
+def test_pipelined_is_default_and_matches_serialized():
+    eng = Engine()
+    assert eng.config.pipeline_depth == 2
+    _register(eng)
+    got = eng.sql(SQL)
+    rec = eng.runner.history[-1]
+    assert rec["pipelined"] is True
+    assert "lock_wait_ms" in rec
+    pd.testing.assert_frame_equal(got, _reference())
+    # the new metric series exist and saw traffic
+    text = eng.metrics.render()
+    assert "tpu_olap_dispatch_lock_wait_ms_count" in text
+    assert "tpu_olap_pipeline_inflight" in text
+    assert "tpu_olap_inflight_transfers" in text
+    hist = eng.metrics.histogram("dispatch_lock_wait_ms")
+    assert hist.series and next(iter(hist.series.values())).n >= 1
+
+
+def test_serialized_mode_still_works():
+    eng = Engine(EngineConfig(pipeline_depth=0))
+    _register(eng)
+    got = eng.sql(SQL)
+    rec = eng.runner.history[-1]
+    assert rec["pipelined"] is False
+    pd.testing.assert_frame_equal(got, _reference())
+
+
+# ------------------------------------------ ledger in-flight pinning
+
+
+def test_ledger_pin_inflight_counts_and_never_evicts():
+    """The eviction-vs-pinned-inflight-result race: a pinned in-flight
+    result's bytes count toward the budget (a concurrent env build must
+    evict resident COLUMNS to make room) but the pin itself is never
+    evictable — the transfer is about to read it."""
+    led = HbmLedger(budget_bytes=1000)
+    evicted = []
+    led.add(("t", "col", "a"), 400, lambda: evicted.append("a"))
+    led.add(("t", "col", "b"), 400, lambda: evicted.append("b"))
+    assert led.bytes_in_use == 800 and not evicted
+    led.pin_inflight(("__inflight__", 1), 500)
+    assert led.bytes_in_use == 1300
+    assert led.inflight_bytes == 500
+    # a new column add must evict the resident columns (LRU first),
+    # NEVER the in-flight pin
+    led.add(("t", "col", "c"), 400, lambda: evicted.append("c"))
+    assert "a" in evicted
+    assert led.inflight_bytes == 500  # pin survived
+    led.unpin_inflight(("__inflight__", 1))
+    assert led.inflight_bytes == 0
+    # unpin released exactly the pinned bytes
+    assert led.bytes_in_use == sum(
+        n for n, _ in led._entries.values())
+
+
+def test_concurrent_queries_under_tight_budget_stay_correct():
+    """Engine-level race: pipelined queries against a 1-byte HBM budget
+    force constant eviction while results are in flight — every thread
+    still gets the exact answer."""
+    eng = Engine(EngineConfig(hbm_budget_bytes=1, pipeline_depth=2))
+    _register(eng)
+    want = _reference()
+    errs = []
+
+    def worker():
+        try:
+            for _ in range(3):
+                pd.testing.assert_frame_equal(eng.sql(SQL), want)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errs, errs
+    assert eng.runner._hbm_ledger.inflight_bytes == 0  # all unpinned
+
+
+# --------------------------------- deadline during a stage-2 transfer
+
+
+class _StallTransfer:
+    """Injector that stalls the host-transfer site once."""
+
+    stages = {"host-transfer"}
+
+    def __init__(self, stall_s):
+        self.stall_s = stall_s
+        self.armed = False
+        self.fired = 0
+
+    def __call__(self, stage, attempt):
+        if self.armed:
+            self.fired += 1
+            self.armed = False
+            time.sleep(self.stall_s)
+
+
+def test_deadline_expiry_during_stage2_transfer():
+    """A transfer that hangs AFTER the lock was released must still
+    trip the watchdog: deadline -> wedge -> fallback answers -> the
+    reprobe clears the wedge and the device path serves again."""
+    inj = _StallTransfer(stall_s=2.0)
+    eng = Engine(EngineConfig(dispatch_retries=0, fault_injector=inj))
+    _register(eng)
+    want = _reference()
+    eng.sql(SQL)  # warm compile outside the deadline regime
+    eng.config.query_deadline_s = 0.4
+    inj.armed = True
+    t0 = time.perf_counter()
+    got = eng.sql(SQL)  # transfer stalls -> deadline -> fallback
+    assert inj.fired == 1
+    assert time.perf_counter() - t0 < 10
+    assert "QueryDeadlineExceeded" in eng.last_plan.fallback_reason
+    assert any(h.get("deadline_exceeded") for h in eng.runner.history)
+    pd.testing.assert_frame_equal(got, want)
+    # recovery: reprobe clears the wedge, device path again
+    eng.config.query_deadline_s = 30.0
+    got2 = eng.sql(SQL)
+    assert eng.last_plan.fallback_reason is None
+    assert not eng.runner._wedged
+    pd.testing.assert_frame_equal(got2, want)
+    time.sleep(1.8)  # let the abandoned transfer thread drain
+
+
+# ------------------------------- breaker trip between enqueue and complete
+
+
+def test_breaker_trips_on_stage2_failure():
+    """A transfer failure between enqueue and complete is a terminal
+    device failure: it counts toward the breaker, and once open the
+    engine serves degraded (path=fallback_breaker) without dispatch."""
+
+    class FailTransfer:
+        stages = {"host-transfer"}
+
+        def __call__(self, stage, attempt):
+            raise RuntimeError("injected transfer loss")
+
+    eng = Engine(EngineConfig(dispatch_retries=0,
+                              breaker_failure_threshold=2,
+                              breaker_open_cooldown_s=30.0,
+                              fault_injector=FailTransfer()))
+    _register(eng)
+    try:
+        want = _reference()
+        for _ in range(2):  # two terminal stage-2 failures trip it
+            pd.testing.assert_frame_equal(eng.sql(SQL), want)
+        assert eng.runner.breaker.state == "open"
+        got = eng.sql(SQL)
+        rec = eng.runner.history[-1]
+        assert rec["path"] == "fallback_breaker"
+        pd.testing.assert_frame_equal(got, want)
+    finally:
+        eng.runner.breaker.close()
+
+
+# ------------------------------------- result cache from a stage-2 completion
+
+
+def test_result_cache_populates_from_stage2_completion():
+    eng = Engine(EngineConfig(result_cache_enabled=True,
+                              pipeline_depth=2))
+    _register(eng)
+    want = _reference()
+    pd.testing.assert_frame_equal(eng.sql(SQL), want)
+    assert eng.runner.history[-1]["pipelined"] is True
+    pd.testing.assert_frame_equal(eng.sql(SQL), want)
+    rec = eng.runner.history[-1]
+    assert rec["path"] == "cache" and rec["cache_tier"] == "full"
+
+
+# ----------------------------------------------- pipeline occupancy bound
+
+
+def test_pipeline_slot_bounds_inflight():
+    ac = AdmissionController(max_inflight=8, queue_limit=8,
+                             pipeline_depth=1)
+    entered, release = threading.Event(), threading.Event()
+
+    def hold():
+        with ac.pipeline_slot():
+            entered.set()
+            release.wait(timeout=30)
+
+    t = threading.Thread(target=hold, daemon=True)
+    t.start()
+    assert entered.wait(5)
+    assert ac.snapshot()["pipeline_inflight"] == 1
+    # a second acquirer with an exhausted budget sheds instead of
+    # queueing forever
+    with pytest.raises(QueryShed) as ei:
+        with ac.pipeline_slot(budget_s=0.05):
+            pass
+    assert ei.value.reason == "pipeline_stall"
+    release.set()
+    t.join(timeout=10)
+    assert ac.snapshot()["pipeline_inflight"] == 0
+    with ac.pipeline_slot():  # reusable after release
+        assert ac.snapshot()["pipeline_inflight"] == 1
+    # re-entrant per thread, like slot()
+    with ac.pipeline_slot():
+        with ac.pipeline_slot():
+            assert ac.snapshot()["pipeline_inflight"] == 1
+
+
+def test_pipeline_slot_disabled_is_noop():
+    ac = AdmissionController(max_inflight=8, queue_limit=8,
+                             pipeline_depth=0)
+    with ac.pipeline_slot():
+        assert ac.snapshot()["pipeline_inflight"] == 0
+
+
+def test_reset_pipeline_reclaims_stranded_slots():
+    """A deadline-abandoned dispatch thread strands its pipeline slot;
+    wedge recovery calls reset_pipeline so device capacity comes back.
+    The stranded holder's eventual release clamps at zero instead of
+    going negative."""
+    ac = AdmissionController(max_inflight=8, queue_limit=8,
+                             pipeline_depth=1)
+    entered, release = threading.Event(), threading.Event()
+
+    def stranded():
+        with ac.pipeline_slot():
+            entered.set()
+            release.wait(timeout=30)
+
+    t = threading.Thread(target=stranded, daemon=True)
+    t.start()
+    assert entered.wait(5)
+    # capacity gone: a budgeted waiter sheds
+    with pytest.raises(QueryShed):
+        with ac.pipeline_slot(budget_s=0.05):
+            pass
+    ac.reset_pipeline()  # wedge recovery reclaims the slot
+    with ac.pipeline_slot(budget_s=0.05):
+        assert ac.snapshot()["pipeline_inflight"] == 1
+    release.set()  # the stranded holder finally drains: clamp, not -1
+    t.join(timeout=10)
+    assert ac.snapshot()["pipeline_inflight"] == 0
+
+
+def test_recovery_survives_stranded_dispatch_lock():
+    """An abandoned stage-1 thread holding dispatch_lock must not hang
+    recovery forever: _recover_after_probe bounds its acquire in
+    pipelined mode, reports failure, and succeeds once the lock
+    drains."""
+    eng = Engine(EngineConfig(pipeline_depth=2))
+    _register(eng)
+    eng.sql(SQL)
+    release = threading.Event()
+    held = threading.Event()
+
+    def strand():
+        eng.runner.dispatch_lock.acquire()
+        held.set()
+        release.wait(timeout=30)
+        eng.runner.dispatch_lock.release()
+
+    t = threading.Thread(target=strand, daemon=True)
+    t.start()
+    assert held.wait(5)
+    t0 = time.perf_counter()
+    assert eng.runner._recover_after_probe(lock_timeout_s=1.0) is False
+    assert time.perf_counter() - t0 < 5  # bounded, not forever
+    assert eng.runner.history[-1].get("device_probe_lock_stranded")
+    release.set()
+    t.join(timeout=10)
+    assert eng.runner._recover_after_probe(lock_timeout_s=1.0) is True
+
+
+def test_sparse_path_leaves_no_inflight_pins():
+    """The sparse dispatch pins its enqueued output like every other
+    device path and unpins on success AND on the over-budget raise."""
+    sql = ("SELECT g, v, sum(v) AS s FROM t GROUP BY g, v "
+           "ORDER BY g, v")
+    eng = Engine(EngineConfig(dense_group_budget=4, pipeline_depth=2))
+    _register(eng)
+    got = eng.sql(sql)
+    assert eng.runner.history[-1].get("sparse")
+    assert eng.runner._hbm_ledger.inflight_bytes == 0
+    ref = Engine(EngineConfig(pipeline_depth=0))
+    _register(ref)
+    pd.testing.assert_frame_equal(got, ref.sql(sql))
+    # overflow path: a sparse budget too small for the present groups
+    # raises (engine serves via fallback) — and still unpins
+    sp = Engine(EngineConfig(dense_group_budget=1,
+                             sparse_group_budget=1, pipeline_depth=2))
+    _register(sp)
+    out = sp.sql(sql)
+    assert len(out) > 3
+    assert sp.runner.history[-1]["query_type"] == "fallback"
+    assert sp.runner._hbm_ledger.inflight_bytes == 0

@@ -43,7 +43,9 @@ def _fail(msg: str):
 
 
 def load_artifact(path: str) -> dict:
-    """{"p50": {q: ms}, "warm": {q: ms}|None, "hit_rate": {q: f}|None}"""
+    """{"p50": {q: ms}, "warm": {q: ms}|None, "hit_rate": {q: f}|None}
+    for latency artifacts, or {"kind": "concurrency", ...} for
+    BENCH_CONCURRENCY.json-shaped throughput artifacts."""
     try:
         with open(path) as f:
             doc = json.load(f)
@@ -54,11 +56,20 @@ def load_artifact(path: str) -> dict:
               "not an object (truncated/corrupt artifact?)")
     if isinstance(doc.get("parsed"), dict) and "detail" not in doc:
         doc = doc["parsed"]  # driver-banked wrapper (BENCH_rNN.json)
+    if "throughput_qps" in doc and isinstance(doc.get("per_class"),
+                                              dict):
+        # concurrency artifact (tools/bench_concurrency.py): gate on
+        # throughput + per-class p99 instead of per-query p50
+        return {"kind": "concurrency",
+                "qps": float(doc["throughput_qps"]),
+                "p99": {str(c): float(v["p99_ms"])
+                        for c, v in doc["per_class"].items()
+                        if isinstance(v, dict) and "p99_ms" in v}}
     detail = doc.get("detail") or {}
     per_query = detail.get("per_query_p50_ms")
     if not isinstance(per_query, dict) or not per_query:
-        _fail(f"{path} has no detail.per_query_p50_ms "
-              "(not a latency-bench artifact?)")
+        _fail(f"{path} has no detail.per_query_p50_ms and no "
+              "throughput_qps (not a bench artifact?)")
 
     def _floats(d):
         try:
@@ -66,7 +77,8 @@ def load_artifact(path: str) -> dict:
         except (TypeError, ValueError) as e:
             _fail(f"{path}: non-numeric p50 entry: {e}")
 
-    out = {"p50": _floats(per_query), "warm": None, "hit_rate": None}
+    out = {"kind": "latency", "p50": _floats(per_query), "warm": None,
+           "hit_rate": None}
     cache = detail.get("cache")
     if isinstance(cache, dict):
         warm = cache.get("per_query_warm_p50_ms")
@@ -92,6 +104,39 @@ def compare(base: dict, new: dict, threshold: float):
     return rows, only_base, only_new
 
 
+def compare_concurrency(base: dict, new: dict, threshold: float) -> int:
+    """Throughput-regression gate for BENCH_CONCURRENCY.json artifacts:
+    exit 1 when throughput_qps dropped more than the threshold, or any
+    class's p99 grew beyond it (with the absolute jitter floor)."""
+    regressions = []
+    bq, nq = base["qps"], new["qps"]
+    dq = (nq - bq) / bq if bq > 0 else 0.0
+    print(f"{'metric':<16}  {'base':>10}  {'new':>10}  {'delta':>8}  "
+          "gate")
+    flag = "ok"
+    if dq < -threshold:
+        regressions.append("throughput_qps")
+        flag = "REGRESSED(qps)"
+    print(f"{'throughput_qps':<16}  {bq:>10.1f}  {nq:>10.1f}  "
+          f"{dq:>+7.1%}  {flag}")
+    for cls in sorted(set(base["p99"]) & set(new["p99"])):
+        b, n = base["p99"][cls], new["p99"][cls]
+        d = (n - b) / b if b > 0 else 0.0
+        reg = d > threshold and (n - b) > ABS_FLOOR_MS
+        if reg:
+            regressions.append(f"{cls}.p99")
+        print(f"{cls + '.p99_ms':<16}  {b:>10.1f}  {n:>10.1f}  "
+              f"{d:>+7.1%}  {'REGRESSED(p99)' if reg else 'ok'}")
+    if regressions:
+        print(f"\nbench_compare: {len(regressions)} concurrency "
+              f"metric(s) regressed past {threshold:.0%}: "
+              f"{', '.join(regressions)}", file=sys.stderr)
+        return 1
+    print(f"\nbench_compare: ok (throughput + per-class p99 within "
+          f"{threshold:.0%})")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="Compare per-query SSB p50s of two bench artifacts "
@@ -110,6 +155,12 @@ def main(argv=None) -> int:
 
     base_art = load_artifact(args.baseline)
     new_art = load_artifact(args.candidate)
+    if base_art["kind"] != new_art["kind"]:
+        _fail(f"artifact kinds differ: {args.baseline} is "
+              f"{base_art['kind']}, {args.candidate} is "
+              f"{new_art['kind']}")
+    if base_art["kind"] == "concurrency":
+        return compare_concurrency(base_art, new_art, args.threshold)
     base, new = base_art["p50"], new_art["p50"]
     rows, only_base, only_new = compare(base, new, args.threshold)
     if not rows:

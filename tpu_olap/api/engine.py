@@ -74,11 +74,15 @@ class Engine:
         # are not concurrent and the chip has one program queue anyway,
         # SURVEY.md §3.5 P1). Planning and the pandas fallback run outside
         # it, so concurrent HTTP clients aren't wedged behind one slow
-        # device query (VERDICT round 1 "missing" #6). The lock now LIVES
+        # device query (VERDICT round 1 "missing" #6). The lock LIVES
         # on the runner (QueryRunner.dispatch_lock) so the shared-scan
         # coalescer can let concurrent callers wait outside it and ride
         # one fused dispatch (executor.batch); this alias keeps the
         # engine-level admin surface (clear_cache) on the same lock.
+        # With pipelined execution (EngineConfig.pipeline_depth > 0, the
+        # default) the runner holds it only for stage-1 enqueue — host
+        # transfer, finalize, and assembly overlap other queries'
+        # device work (docs/PERF_MODEL.md "execution pipeline").
         self.device_lock = self.runner.dispatch_lock
         # planner-initiated subquery execution (uncorrelated shapes
         # inline as literals so the outer query can push down; the inner

@@ -16,7 +16,9 @@ equivalent is a JSON-over-HTTP surface (stdlib only, no new deps):
   GET  /metrics      Prometheus text exposition (tpu_olap.obs.metrics:
                      latency histograms by query_type/path, scan/cache/
                      retry counters, HBM ledger gauges, resilience
-                     gauges/counters)
+                     gauges/counters, pipelined-execution series —
+                     dispatch_lock_wait_ms, pipeline_inflight,
+                     inflight_transfers)
   GET  /debug/queries  recent span trees + the slow-query log ring
                      (EngineConfig.slow_query_ms; docs/OBSERVABILITY.md)
   GET  /debug/events   the structured event log ring, newest first
@@ -133,6 +135,14 @@ class QueryServer:
         self._inflight_cond = threading.Condition()
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 keep-alive: a BI client (or the concurrency
+            # bench) reuses one connection per thread instead of a TCP
+            # handshake + accept-loop round trip per request — under
+            # high client churn the single accept thread was the p99
+            # tail, not the engine. Safe because every response path
+            # (_send/_send_text) sets an exact Content-Length.
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *a):  # quiet; engine.history observes
                 pass
 

@@ -129,15 +129,16 @@ def run_batch(runner, queries, table, query_ids=None) -> list:
 
     for q, idxs, plan in singles:
         try:
-            # _execute_locked, not _execute: the single-leg path keeps
+            # _execute_guarded, not _execute: the single-leg path keeps
             # the deadline watchdog + wedged-device reprobe of a plain
-            # execute() call (run_batch's caller holds dispatch_lock).
-            # The statement's own id is propagated BEFORE record()
-            # fires, so the history record and its `query` event agree
-            # (a post-hoc rewrite would leave the event carrying the
-            # leader's trace id).
+            # execute() call (serialized mode: run_batch's caller holds
+            # dispatch_lock; pipelined mode: the leg's own enqueue
+            # sections take it). The statement's own id is propagated
+            # BEFORE record() fires, so the history record and its
+            # `query` event agree (a post-hoc rewrite would leave the
+            # event carrying the leader's trace id).
             with use_query_id(query_ids[idxs[0]] or None):
-                res = runner._execute_locked(q, table)
+                res = runner._execute_guarded(q, table)
         except BaseException as e:  # noqa: BLE001 — boxed per leg
             for i in idxs:
                 boxed[i] = e
@@ -162,7 +163,7 @@ def run_batch(runner, queries, table, query_ids=None) -> list:
             try:
                 if len(group) == 1:  # a max-size split remainder
                     q, idxs, plan = group[0]
-                    results = [runner._execute_locked(q, table)]
+                    results = [runner._execute_guarded(q, table)]
                 else:
                     results = _run_fused(runner, table, group, query_ids)
             except BaseException as e:  # noqa: BLE001 — boxed per leg
@@ -263,22 +264,51 @@ def _run_fused(runner, table, group, query_ids=None):
     def dispatch():
         # env build lives INSIDE the retried callable: a _dispatch retry
         # purges the table's device state, so the rebuilt attempt must
-        # re-prepare (stale buffers could be poisoned by a device reset)
-        leg_envs, seg_masks = [], []
-        valid = None
-        for plan, m in zip(plans, metrics_list):
-            env, valid, seg_mask = runner._prepare(plan, m)
-            leg_envs.append(env)
-            seg_masks.append(seg_mask)
-        win = _union_window(plans, len(seg_masks[0]))
-        if win is not None:
-            for m in metrics_list:
-                m["segments_window"] = win[1]
-        if runner.config.platform == "cpu":
-            return _run_fused_numpy(runner, plans, leg_envs, valid,
-                                    seg_masks, win) + (False,)
-        return _run_fused_device(runner, table, plans, leg_envs, valid,
-                                 seg_masks, win)
+        # re-prepare (stale buffers could be poisoned by a device reset).
+        # Two-staged like the single-query path (ISSUE 10): stage 1
+        # (env build + fused program fire) under the enqueue lock,
+        # stage 2 (transfer / the numpy shared scan) lock-free — the
+        # leader no longer holds dispatch_lock while it computes or
+        # assembles.
+        with runner._pipeline_slot():
+            with runner._enqueue_lock(metrics_list[0]):
+                leg_envs, seg_masks = [], []
+                valid = None
+                for plan, m in zip(plans, metrics_list):
+                    env, valid, seg_mask = runner._prepare(plan, m)
+                    leg_envs.append(env)
+                    seg_masks.append(seg_mask)
+                win = _union_window(plans, len(seg_masks[0]))
+                if win is not None:
+                    for m in metrics_list:
+                        m["segments_window"] = win[1]
+                enq = pin = None
+                if runner.config.platform != "cpu":
+                    enq = _enqueue_fused_device(
+                        runner, table, plans, leg_envs, valid,
+                        seg_masks, win)
+                    pin = runner._pin_inflight(enq[0])
+            if metrics_list[0].get("pipelined"):
+                for m in metrics_list[1:]:
+                    m["pipelined"] = True
+            if enq is None:
+                # numpy shared scan: the chunked compute reads only its
+                # own env references, so it runs outside the lock
+                return _run_fused_numpy(runner, plans, leg_envs, valid,
+                                        seg_masks, win) + (False,)
+            outs_dev, hit, t_fire = enq
+            outs = runner._fetch_tree(outs_dev, metrics_list[0], pin)
+            shared_ms = (time.perf_counter() - t_fire) * 1000
+            # per-leg attribution: one XLA program cannot be timed from
+            # outside per leg; split the shared wall by each leg's
+            # scanned-work weight (columns read x segments scanned x
+            # agg plans) — an estimate, labeled as such in
+            # docs/BATCH_EXECUTION.md
+            w = [max(1, (len(p.columns) + 1) * max(1, len(p.pruned_ids))
+                     * (len(p.agg_plans) + 1)) for p in plans]
+            tw = float(sum(w))
+            agg_ms = [shared_ms * wi / tw for wi in w]
+            return outs, shared_ms, agg_ms, hit
 
     # retry-based recovery identical to the single-query path (the
     # shared metrics of leg 0 carry any retry_errors), under the same
@@ -403,11 +433,12 @@ def _window_fused(fused, W: int):
     return fn
 
 
-def _run_fused_device(runner, table, plans, leg_envs, valid, seg_masks,
-                      win):
-    """One jitted fused program per batch composition. Returns
-    (partials per leg, shared wall ms, attributed per-leg ms, cache hit).
-    """
+def _enqueue_fused_device(runner, table, plans, leg_envs, valid,
+                          seg_masks, win):
+    """Stage 1 of the fused pass (caller holds the enqueue lock): one
+    jitted fused program per batch composition, fired asynchronously.
+    Returns (device output trees, jit-cache hit, fire timestamp); the
+    caller transfers with runner._fetch_tree outside the lock."""
     import jax
 
     buffers, layouts = _buffer_layout(leg_envs)
@@ -438,17 +469,7 @@ def _run_fused_device(runner, table, plans, leg_envs, valid, seg_masks,
     outs = jitted(buffers, valid, seg_args, consts_list, win[0]) \
         if win is not None else jitted(buffers, valid, seg_args,
                                        consts_list)
-    outs = [{k: np.asarray(v) for k, v in o.items()} for o in outs]
-    shared_ms = (time.perf_counter() - t0) * 1000
-    # per-leg attribution: one XLA program cannot be timed from outside
-    # per leg; split the shared wall by each leg's scanned-work weight
-    # (columns read x segments scanned x agg plans) — an estimate,
-    # labeled as such in docs/BATCH_EXECUTION.md
-    w = [max(1, (len(p.columns) + 1) * max(1, len(p.pruned_ids))
-             * (len(p.agg_plans) + 1)) for p in plans]
-    tw = float(sum(w))
-    agg_ms = [shared_ms * wi / tw for wi in w]
-    return outs, shared_ms, agg_ms, hit
+    return outs, hit, t0
 
 
 def _run_fused_numpy(runner, plans, leg_envs, valid, seg_masks, win):

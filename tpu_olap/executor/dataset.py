@@ -9,6 +9,7 @@ masked segments cost compute but no transfer — the dense-scan tradeoff).
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 
 import numpy as np
@@ -23,41 +24,75 @@ class HbmLedger:
     least-recently-used unpinned buffers are evicted first; buffers the
     in-flight query needs are pinned for the duration of its env build.
     A single over-budget column still uploads (the query must run) —
-    the budget bounds the cache, not one query's working set."""
+    the budget bounds the cache, not one query's working set.
+
+    In-flight result pinning (pipelined execution, docs/PERF_MODEL.md):
+    between stage-1 enqueue and stage-2 transfer, a dispatch's output
+    buffers live in HBM outside the column cache. `pin_inflight` counts
+    those bytes toward the budget — so a concurrent query's env build
+    evicts resident columns to make room rather than silently
+    overcommitting HBM — and they are never themselves evictable (the
+    transfer is about to read them). Mutations are internally locked:
+    stage-2 unpins run lock-free with respect to dispatch_lock."""
 
     def __init__(self, budget_bytes: int | None):
         self.budget = budget_bytes
         self._entries: OrderedDict[tuple, tuple[int, object]] = \
             OrderedDict()  # key -> (nbytes, evict_fn)
+        self._inflight: dict[tuple, int] = {}  # pinned result buffers
+        self._mu = threading.RLock()
         self.bytes_in_use = 0
         self.evictions = 0
 
+    @property
+    def inflight_bytes(self) -> int:
+        with self._mu:
+            return sum(self._inflight.values())
+
     def touch(self, key):
-        if key in self._entries:
-            self._entries.move_to_end(key)
+        with self._mu:
+            if key in self._entries:
+                self._entries.move_to_end(key)
 
     def add(self, key, nbytes: int, evict_fn, pinned=frozenset()):
-        if self.budget is not None:
-            for k in list(self._entries):
-                if self.bytes_in_use + nbytes <= self.budget:
-                    break
-                if k in pinned:
-                    continue
-                n, fn = self._entries.pop(k)
+        with self._mu:
+            if self.budget is not None:
+                for k in list(self._entries):
+                    if self.bytes_in_use + nbytes <= self.budget:
+                        break
+                    if k in pinned:
+                        continue
+                    n, fn = self._entries.pop(k)
+                    self.bytes_in_use -= n
+                    self.evictions += 1
+                    fn()
+            self._entries[key] = (nbytes, evict_fn)
+            self.bytes_in_use += nbytes
+
+    def pin_inflight(self, key, nbytes: int):
+        """Account a dispatch's not-yet-transferred output buffers:
+        counted in bytes_in_use (so later adds evict columns to stay
+        within budget) but never in the evictable entry set."""
+        with self._mu:
+            self._inflight[key] = int(nbytes)
+            self.bytes_in_use += int(nbytes)
+
+    def unpin_inflight(self, key):
+        with self._mu:
+            n = self._inflight.pop(key, None)
+            if n is not None:
                 self.bytes_in_use -= n
-                self.evictions += 1
-                fn()
-        self._entries[key] = (nbytes, evict_fn)
-        self.bytes_in_use += nbytes
 
     def remove(self, key):
-        e = self._entries.pop(key, None)
-        if e is not None:
-            self.bytes_in_use -= e[0]
+        with self._mu:
+            e = self._entries.pop(key, None)
+            if e is not None:
+                self.bytes_in_use -= e[0]
 
     def remove_table(self, table_name: str):
-        for k in [k for k in self._entries if k[0] == table_name]:
-            self.remove(k)
+        with self._mu:
+            for k in [k for k in self._entries if k[0] == table_name]:
+                self.remove(k)
 
 
 class DeviceDataset:

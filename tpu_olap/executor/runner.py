@@ -10,8 +10,10 @@ reference's DruidQueryHistory (SURVEY.md §3.2 "Query-history").
 from __future__ import annotations
 
 import functools
+import itertools
 import time
 from collections import OrderedDict
+from contextlib import contextmanager, nullcontext
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -77,17 +79,23 @@ class HistoryRing(list):
     (bench.py, tests, tools) slice and len() it freely, and the ring is
     small enough that the O(maxlen) front-eviction memmove is noise
     next to any query. Aggregate counters never re-sum this structure;
-    QueryRunner.record maintains them incrementally."""
+    QueryRunner.record maintains them incrementally. Appends are
+    internally locked: pipelined execution completes queries on
+    concurrent stage-2 threads, and two racing evictions must not each
+    delete a survivor."""
 
     def __init__(self, maxlen: int | None = None):
+        import threading
         super().__init__()
         self.maxlen = maxlen if maxlen is None else max(1, int(maxlen))
+        self._mu = threading.Lock()
 
     def append(self, item):
-        super().append(item)
-        if self.maxlen is not None:
-            while len(self) > self.maxlen:
-                del self[0]
+        with self._mu:
+            super().append(item)
+            if self.maxlen is not None:
+                while len(self) > self.maxlen:
+                    del self[0]
 
 
 # core metric keys every completed-query record carries, whatever path
@@ -96,6 +104,7 @@ class HistoryRing(list):
 CORE_METRIC_DEFAULTS = (
     ("total_ms", 0.0), ("rows_scanned", 0), ("segments_scanned", 0),
     ("cache_hit", False), ("query_type", "?"), ("datasource", "?"),
+    ("pipelined", False),
 )
 
 
@@ -160,6 +169,15 @@ class QueryRunner:
         # engine-level admin ops and runner-level dispatch share one
         # lock; coalesced callers wait OUTSIDE it (executor.batch).
         self.dispatch_lock = threading.RLock()
+        # pipelined execution (EngineConfig.pipeline_depth > 0): stage 1
+        # (enqueue) holds dispatch_lock only while the device program is
+        # fired; stage 2 (transfer/finalize/assemble) runs lock-free on
+        # the caller's thread. The plan cache gets its own mutex because
+        # lowering now runs outside the dispatch critical section.
+        self._cache_lock = threading.Lock()
+        self._tls = threading.local()   # per-thread _last_metrics
+        self._inflight_seq = itertools.count(1)  # ledger pin keys
+        self._transfer_count = 0        # live stage-2 transfers (gauge)
         self._coalescer = None
         self._batch_seq = 0
         if (self.config.batch_window_ms or 0) > 0:
@@ -177,7 +195,6 @@ class QueryRunner:
         #                                  PhysicalPlans, per query JSON
         self._mesh = None
         self._active_shards = config.num_shards if config else None
-        self._last_metrics: dict = {}
         self._wedged = False   # a deadline expired; re-probe before trusting
         self.history = HistoryRing(self.config.history_limit)
         # observability (tpu_olap.obs): span-tree tracer + incremental
@@ -261,13 +278,27 @@ class QueryRunner:
             "compile_ms_total",
             "Milliseconds spent in cold dispatches that built an "
             "executable (trace + XLA compile + first execution).")
+        # pipelined-execution observability (ISSUE 10): how long callers
+        # wait for the dispatch lock (the contention the pipeline
+        # shrinks) and how many stage-2 transfers are live right now
+        from tpu_olap.obs.metrics import QUEUE_WAIT_BUCKETS_MS
+        self._m_lock_wait = m.histogram(
+            "dispatch_lock_wait_ms",
+            "Wait to acquire the dispatch lock (stage-1 enqueue in "
+            "pipelined mode; whole-query hold in serialized mode).",
+            buckets=QUEUE_WAIT_BUCKETS_MS)
+        self._m_transfers = m.gauge(
+            "inflight_transfers",
+            "Device->host result transfers currently in flight "
+            "(stage-2 completions).")
         # resilience layer (tpu_olap.resilience; docs/RESILIENCE.md):
         # bounded admission in front of dispatch_lock, plus the device
         # circuit breaker whose healer probes via _healer_probe
         self.admission = AdmissionController(
             self.config.max_inflight_dispatches,
             self.config.admission_queue_limit, metrics=m,
-            events=self.events)
+            events=self.events,
+            pipeline_depth=self.config.pipeline_depth)
         self.breaker = CircuitBreaker(
             self.config.breaker_failure_threshold,
             self.config.breaker_open_cooldown_s,
@@ -289,6 +320,122 @@ class QueryRunner:
         fault."""
         maybe_inject(self.config, stage,
                      getattr(self._attempt_local, "value", 0))
+
+    # --------------------------------------------- pipelined execution
+
+    @property
+    def _last_metrics(self) -> dict:
+        """Per-THREAD current-query metrics dict: pipelined execution
+        runs several queries' stages concurrently, so a shared attr
+        would let one query's failure handler read another's record."""
+        return getattr(self._tls, "last_metrics", {})
+
+    @_last_metrics.setter
+    def _last_metrics(self, value: dict):
+        self._tls.last_metrics = value
+
+    @property
+    def _pipelined(self) -> bool:
+        """Pipelined mode: dispatch_lock held only for stage-1 enqueue
+        (EngineConfig.pipeline_depth > 0); 0 restores the serialized
+        whole-query hold."""
+        return (self.config.pipeline_depth or 0) > 0
+
+    def _pipeline_slot(self):
+        """Bound one dispatch's enqueue->complete region (admission-
+        accounted, docs/PERF_MODEL.md). No-op when serialized."""
+        if not self._pipelined:
+            return nullcontext()
+        return self.admission.pipeline_slot(self.config.query_deadline_s)
+
+    @contextmanager
+    def _enqueue_lock(self, metrics: dict | None = None):
+        """Stage-1 critical section. Pipelined mode: acquire
+        dispatch_lock (bounded by the deadline budget so an abandoned
+        watchdog thread blocked here eventually exits instead of
+        leaking), time the wait into dispatch_lock_wait_ms, and stamp
+        the record. Serialized mode: the caller already holds the lock
+        across the whole query (QueryRunner.execute) — possibly on the
+        watchdog's parent thread — so this is a no-op."""
+        if not self._pipelined:
+            yield
+            return
+        deadline = self.config.query_deadline_s
+        t0 = time.perf_counter()
+        ok = self.dispatch_lock.acquire(timeout=deadline) \
+            if deadline is not None else self.dispatch_lock.acquire()
+        waited = (time.perf_counter() - t0) * 1000
+        self._m_lock_wait.observe(waited)
+        if metrics is not None:
+            metrics["pipelined"] = True
+            metrics["lock_wait_ms"] = round(
+                metrics.get("lock_wait_ms", 0.0) + waited, 3)
+        if not ok:
+            raise QueryDeadlineExceeded(
+                f"dispatch lock unavailable within the {deadline}s "
+                "deadline (a dispatch is wedged holding it)") from None
+        try:
+            yield
+        finally:
+            self.dispatch_lock.release()
+
+    @contextmanager
+    def _timed_dispatch_lock(self):
+        """Serialized-mode whole-query lock hold, with the wait observed
+        into the same dispatch_lock_wait_ms histogram the pipelined
+        sections feed — so an A/B reads lock contention from one
+        series."""
+        t0 = time.perf_counter()
+        with self.dispatch_lock:
+            self._m_lock_wait.observe((time.perf_counter() - t0) * 1000)
+            yield
+
+    def _note_transfer(self, delta: int):
+        with self._totals_lock:
+            self._transfer_count += delta
+            self._m_transfers.set(self._transfer_count)
+
+    def _pin_inflight(self, out):
+        """Account a just-enqueued dispatch's output buffers in the HBM
+        ledger until stage 2 transfers them (shapes/dtypes are known
+        without blocking on the async computation). Returns the pin key
+        for _fetch_tree, or None on the numpy platform."""
+        if self.config.platform == "cpu":
+            return None
+        import jax
+        nbytes = sum(int(getattr(a, "nbytes", 0) or 0)
+                     for a in jax.tree_util.tree_leaves(out))
+        key = ("__inflight__", next(self._inflight_seq))
+        self._hbm_ledger.pin_inflight(key, nbytes)
+        return key
+
+    def _fetch_tree(self, out, metrics: dict | None = None, pin=None):
+        """Stage-2 device->host transfer: ONE jax.device_get round trip
+        for the whole output tree (instead of one np.asarray per
+        aggregate column — one tunnel RTT, not one per array). Unpins
+        the in-flight ledger entry and maintains the transfer gauge;
+        the host-transfer fault site fires here."""
+        t0 = time.perf_counter()
+        if self.config.platform != "cpu" and pin is None:
+            pin = self._pin_inflight(out)
+        self._note_transfer(1)
+        try:
+            self._inject("host-transfer")
+            if self.config.platform == "cpu":
+                host = {k: np.asarray(v) for k, v in out.items()} \
+                    if isinstance(out, dict) else np.asarray(out)
+            else:
+                import jax
+                host = jax.device_get(out)
+        finally:
+            self._note_transfer(-1)
+            if pin is not None:
+                self._hbm_ledger.unpin_inflight(pin)
+        if metrics is not None:
+            metrics["transfer_ms"] = round(
+                metrics.get("transfer_ms", 0.0)
+                + (time.perf_counter() - t0) * 1000, 3)
+        return host
 
     def _metric_path(self, m: dict) -> str:
         """Dashboard path label: which execution flavor served this
@@ -519,6 +666,14 @@ class QueryRunner:
                 return out
             except UnsupportedAggregation:
                 raise  # structural, not transient: straight to fallback
+            except QueryError:
+                # taxonomy failures originating inside a pipelined
+                # dispatch (lock unavailable within the deadline, a
+                # pipeline-slot shed): lock/queue starvation, not device
+                # sickness — no retry (it would re-wait the same
+                # resource), no breaker failure (the holder's own
+                # watchdog accounts for a real wedge)
+                raise
             except Exception as e:
                 # record every retried error so poisoned-device vs
                 # deterministic failures are distinguishable in history
@@ -531,15 +686,25 @@ class QueryRunner:
                     self.breaker.record_failure()
                     raise
                 metrics["retries"] = attempt + 1
-                if self.config.degrade_shards_on_retry and \
-                        (self._active_shards or 1) > 1:
-                    # mesh shrink invalidates every table's shardings
-                    self.clear_cache()
-                    self._mesh = None
-                    self._active_shards = max(1, self._active_shards // 2)
-                    metrics["degraded_shards"] = self._active_shards
-                else:
-                    self.clear_cache(table_name)
+                # in pipelined mode nothing outer holds dispatch_lock,
+                # and the structural purges below must not race another
+                # query's stage-1 enqueue; serialized mode keeps the
+                # historical behavior (caller holds the lock — or, on a
+                # deadline watchdog thread, the purge is lock-free and
+                # tolerated, see _run_with_deadline)
+                purge_lock = self.dispatch_lock if self._pipelined \
+                    else nullcontext()
+                with purge_lock:
+                    if self.config.degrade_shards_on_retry and \
+                            (self._active_shards or 1) > 1:
+                        # mesh shrink invalidates every table's shardings
+                        self.clear_cache()
+                        self._mesh = None
+                        self._active_shards = max(
+                            1, self._active_shards // 2)
+                        metrics["degraded_shards"] = self._active_shards
+                    else:
+                        self.clear_cache(table_name)
 
     # ------------------------------------------------------------------ API
 
@@ -570,9 +735,14 @@ class QueryRunner:
     def _execute_batch_boxed(self, queries, table, query_ids=None) -> list:
         from tpu_olap.executor.batch import run_batch
         # one admission slot per batch submission: the fused dispatch is
-        # one device occupancy however many logical queries ride it
+        # one device occupancy however many logical queries ride it.
+        # Pipelined mode: no outer lock — run_batch's device sections
+        # take it per dispatch, so the leader no longer holds the lock
+        # during per-leg finalize/assembly (docs/BATCH_EXECUTION.md).
         with self.admission.slot(self.config.query_deadline_s):
-            with self.dispatch_lock:
+            if self._pipelined:
+                return run_batch(self, queries, table, query_ids)
+            with self._timed_dispatch_lock():
                 return run_batch(self, queries, table, query_ids)
 
     def _next_batch_id(self) -> int:
@@ -628,10 +798,20 @@ class QueryRunner:
                            batch_size=res.metrics.get("batch_size"))
                 return res
         with self.admission.slot(self.config.query_deadline_s):
-            with self.dispatch_lock:
-                return self._execute_locked(query, table)
+            if self._pipelined:
+                # two-stage pipeline: _execute_guarded's dispatch
+                # sections take dispatch_lock for stage-1 enqueue only;
+                # transfer/finalize/assembly run lock-free, so query B's
+                # device compute overlaps query A's RTT + assembly
+                return self._execute_guarded(query, table)
+            with self._timed_dispatch_lock():
+                return self._execute_guarded(query, table)
 
-    def _execute_locked(self, query, table) -> QueryResult:
+    def _execute_guarded(self, query, table) -> QueryResult:
+        """Breaker + deadline/wedge guard around _execute. Serialized
+        mode: the caller holds dispatch_lock across this whole call.
+        Pipelined mode: no outer lock — the per-dispatch enqueue
+        sections (_enqueue_lock) take it."""
         self.breaker.check()
         deadline = self.config.query_deadline_s
         if deadline is not None:
@@ -728,18 +908,45 @@ class QueryRunner:
         t.join(timeout)
         return ok.is_set()
 
-    def _recover_after_probe(self):
+    def _recover_after_probe(self, lock_timeout_s: float | None = None
+                             ) -> bool:
         """Probe succeeded: clear the wedge and purge device-resident
         DATA (buffers a reset would poison) but keep compiled
         executables — recompiling every template would eat the next
         query's deadline; if an executable is also poisoned, the
-        _dispatch retry layer purges the table's full cache anyway."""
-        self._wedged = False
-        for ds in list(self._datasets.values()):
-            ds.evict()
-        self._datasets.clear()
-        self._arg_cache.clear()
+        _dispatch retry layer purges the table's full cache anyway.
+        Holds dispatch_lock itself (re-entrant for the serialized path,
+        where the caller already owns it): in pipelined mode the purge
+        must not race another query's stage-1 env build. Pipelined
+        acquisition is BOUNDED: an abandoned stage-1 thread can strand
+        the lock (it hung inside the jitted fire), and blocking here
+        forever would hang every recovery path on the caller thread —
+        returns False instead (callers treat it as probe failure, so
+        the breaker keeps the engine on degraded serving until the
+        stranded holder drains). Success also reclaims pipeline slots
+        stranded by abandoned dispatch threads."""
+        if self._pipelined:
+            t = 5.0 if lock_timeout_s is None \
+                else max(1.0, float(lock_timeout_s))
+            if not self.dispatch_lock.acquire(timeout=t):
+                self.record({"device_probe_lock_stranded": True})
+                return False
+        else:
+            self.dispatch_lock.acquire()
+        try:
+            self._wedged = False
+            for ds in list(self._datasets.values()):
+                ds.evict()
+            self._datasets.clear()
+            self._arg_cache.clear()
+        finally:
+            self.dispatch_lock.release()
+        # reclaim in-flight pipeline slots held by abandoned dispatch
+        # threads: the device is verified healthy and its state purged,
+        # so the stranded holders' slots must not zero device capacity
+        self.admission.reset_pipeline()
         self.record({"device_probe_recovered": True})
+        return True
 
     def _reprobe_device(self, deadline: float):
         """Post-wedge health check: a trivial device round-trip under the
@@ -751,7 +958,11 @@ class QueryRunner:
             self.breaker.record_failure("probe")
             raise QueryDeadlineExceeded(
                 "device still unresponsive after a deadline-expired query")
-        self._recover_after_probe()
+        if not self._recover_after_probe(deadline):
+            self.breaker.record_failure("probe")
+            raise QueryDeadlineExceeded(
+                "device answered the probe but the dispatch lock is "
+                "stranded by an abandoned dispatch")
 
     def _healer_probe(self) -> bool:
         """The breaker healer's half-open probe (resilience.breaker):
@@ -762,13 +973,12 @@ class QueryRunner:
         if not self._probe_device(timeout):
             self.record({"device_probe_failed": True})
             return False
-        # under dispatch_lock: a query that slipped through during
-        # half-open may be mid-dispatch on these datasets — the reprobe
-        # path gets this for free (it runs inside _execute_locked), the
-        # healer thread must take it explicitly
-        with self.dispatch_lock:
-            self._recover_after_probe()
-        return True
+        # _recover_after_probe takes dispatch_lock itself (bounded in
+        # pipelined mode): a query that slipped through during half-open
+        # may be mid-enqueue on these datasets. A stranded lock returns
+        # False -> the breaker stays open and the healer retries next
+        # cooldown, until the stranded holder drains.
+        return self._recover_after_probe(timeout)
 
     def _execute(self, query, table, abandoned=None) -> QueryResult:
         t0 = time.perf_counter()
@@ -884,15 +1094,21 @@ class QueryRunner:
                c.dense_sketch_state_budget,
                c.pallas_rows_per_block, c.pallas_k_per_block,
                c.pallas_auto_flop_budget)
-        hit = self._plan_cache.get(key)
-        if hit is not None and hit[0] is table:
-            _cache_lru_hit(self._plan_cache, key)
-            return hit[1]
+        # _cache_lock, not dispatch_lock: pipelined execution lowers
+        # outside the dispatch critical section, concurrently across
+        # threads. lower() itself runs unlocked (pure per-query work);
+        # a duplicate concurrent lowering is last-write-wins.
+        with self._cache_lock:
+            hit = self._plan_cache.get(key)
+            if hit is not None and hit[0] is table:
+                _cache_lru_hit(self._plan_cache, key)
+                return hit[1]
         plan = lower(query, table, self.config)
-        if len(self._plan_cache) > 512:
-            _evict_one(self._plan_cache)
-            self._m_cache_evict.inc(cache="plan")
-        self._plan_cache[key] = (table, plan)
+        with self._cache_lock:
+            if len(self._plan_cache) > 512:
+                _evict_one(self._plan_cache)
+                self._m_cache_evict.inc(cache="plan")
+            self._plan_cache[key] = (table, plan)
         return plan
 
     def _execute_inner(self, query, table) -> QueryResult:
@@ -924,7 +1140,9 @@ class QueryRunner:
             result_entries=purged["full"],
             segment_entries=purged["segment"])
         # list() snapshots: an abandoned deadline thread may insert
-        # concurrently (see _run_with_deadline) — never iterate live dicts
+        # concurrently (see _run_with_deadline) — never iterate live
+        # dicts. Plan-cache mutation additionally takes _cache_lock:
+        # pipelined lowering reads it outside dispatch_lock.
         if table_name is None:
             for ds in list(self._datasets.values()):
                 ds.evict()
@@ -932,7 +1150,8 @@ class QueryRunner:
             self._jit_cache.clear()
             self._arg_cache.clear()
             self._cap_hints.clear()
-            self._plan_cache.clear()
+            with self._cache_lock:
+                self._plan_cache.clear()
         elif table_name in self._datasets:
             self._datasets.pop(table_name).evict()
             self._jit_cache = OrderedDict(
@@ -945,9 +1164,10 @@ class QueryRunner:
                                if k[0] != table_name}
             # plans pin their TableSegments (host column arrays): drop
             # them too or a re-registration keeps the old data alive
-            self._plan_cache = OrderedDict(
-                (k, v) for k, v in list(self._plan_cache.items())
-                if k[0] != table_name)
+            with self._cache_lock:
+                self._plan_cache = OrderedDict(
+                    (k, v) for k, v in list(self._plan_cache.items())
+                    if k[0] != table_name)
 
     # ------------------------------------------------------------- dispatch
 
@@ -1128,29 +1348,38 @@ class QueryRunner:
                 "nulls": {c: a[sl] for c, a in env["nulls"].items()}}
         return wenv, valid[sl], seg_mask[sl]
 
-    def _run_partials(self, plan: PhysicalPlan, metrics: dict) -> dict:
-        env, valid, seg_mask = self._prepare(plan, metrics)
-        win = self._segment_window(plan, len(seg_mask))
-        if win is not None:
-            metrics["segments_window"] = win[1]
-
-        n_seg_full = len(seg_mask)
-
-        def _embed_mask(out):
-            """Windowed mask back into the full segment stack: every
-            consumer (scan/select/search assembly) indexes rows by
-            GLOBAL segment id; segments outside the window are pruned,
-            so their rows are legitimately all-False."""
-            if win is None or plan.kind != "mask":
-                return out
-            lo, W = win
-            w = out["mask"].reshape(W, -1)
-            full = np.zeros((n_seg_full, w.shape[1]), bool)
-            full[lo:lo + W] = w
-            out["mask"] = full.reshape(-1)
+    @staticmethod
+    def _embed_windowed_mask(out: dict, plan: PhysicalPlan, win,
+                             n_seg_full: int) -> dict:
+        """Windowed mask back into the full segment stack: every
+        consumer (scan/select/search assembly) indexes rows by
+        GLOBAL segment id; segments outside the window are pruned,
+        so their rows are legitimately all-False."""
+        if win is None or plan.kind != "mask":
             return out
+        lo, W = win
+        w = np.asarray(out["mask"]).reshape(W, -1)
+        full = np.zeros((n_seg_full, w.shape[1]), bool)
+        full[lo:lo + W] = w
+        out["mask"] = full.reshape(-1)
+        return out
 
+    def _run_partials(self, plan: PhysicalPlan, metrics: dict) -> dict:
         if self.config.platform == "cpu":
+            return self._run_partials_numpy(plan, metrics)
+        return self._run_partials_jax(plan, metrics)
+
+    def _run_partials_numpy(self, plan: PhysicalPlan,
+                            metrics: dict) -> dict:
+        with self._pipeline_slot():
+            # stage 1: only the env build (dataset/ledger mutation)
+            # needs the lock — the numpy kernel reads its own slices
+            with self._enqueue_lock(metrics):
+                env, valid, seg_mask = self._prepare(plan, metrics)
+            win = self._segment_window(plan, len(seg_mask))
+            if win is not None:
+                metrics["segments_window"] = win[1]
+            n_seg_full = len(seg_mask)
             t0 = time.perf_counter()
             with _span("dispatch", jit_cache_hit=False, num_shards=1):
                 if win is not None:
@@ -1161,42 +1390,60 @@ class QueryRunner:
             metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
             metrics["jit_cache_hit"] = False
             metrics["num_shards"] = 1
-            return _embed_mask({k: np.asarray(v) for k, v in out.items()})
-
-        import jax
-        mesh = self.mesh
-        key = plan.fingerprint() + ((mesh.devices.size,) if mesh else ()) \
-            + ((win[1],) if win else ())
-        jitted = self._jit_cache.get(key)
-        hit = jitted is not None
-        if hit:
-            _cache_lru_hit(self._jit_cache, key)
-        else:
-            if mesh is not None:
-                from tpu_olap.executor.sharding import sharded_kernel
-                jitted = jax.jit(sharded_kernel(plan, mesh))
-            elif win is not None:
-                jitted = jax.jit(self._window_kernel(plan.kernel, win[1]))
-            else:
-                jitted = jax.jit(plan.kernel)
-            self._jit_cache[key] = jitted
-            self._note_compile("partials", metrics)
-        t0 = time.perf_counter()
-        with _span("dispatch", jit_cache_hit=hit,
-                   num_shards=mesh.devices.size if mesh else 1):
-            consts_dev, seg_arg = self._args_for(plan, seg_mask, mesh)
-            out = jitted(env, valid, seg_arg, consts_dev, win[0]) \
-                if win is not None \
-                else jitted(env, valid, seg_arg, consts_dev)
-        with _span("host-transfer"):
-            # jax dispatch is async: materializing to numpy is where the
-            # device round-trip actually blocks
-            self._inject("host-transfer")
             out = {k: np.asarray(v) for k, v in out.items()}
+        return self._embed_windowed_mask(out, plan, win, n_seg_full)
+
+    def _run_partials_jax(self, plan: PhysicalPlan,
+                          metrics: dict) -> dict:
+        import jax
+        with self._pipeline_slot():
+            # stage 1 (enqueue, under dispatch_lock): env build, jit
+            # cache, per-call args, and the async dispatch itself —
+            # the lock releases once the device has the work and the
+            # result buffers are pinned in the HbmLedger
+            with self._enqueue_lock(metrics):
+                env, valid, seg_mask = self._prepare(plan, metrics)
+                win = self._segment_window(plan, len(seg_mask))
+                if win is not None:
+                    metrics["segments_window"] = win[1]
+                n_seg_full = len(seg_mask)
+                mesh = self.mesh
+                key = plan.fingerprint() \
+                    + ((mesh.devices.size,) if mesh else ()) \
+                    + ((win[1],) if win else ())
+                jitted = self._jit_cache.get(key)
+                hit = jitted is not None
+                if hit:
+                    _cache_lru_hit(self._jit_cache, key)
+                else:
+                    if mesh is not None:
+                        from tpu_olap.executor.sharding import \
+                            sharded_kernel
+                        jitted = jax.jit(sharded_kernel(plan, mesh))
+                    elif win is not None:
+                        jitted = jax.jit(
+                            self._window_kernel(plan.kernel, win[1]))
+                    else:
+                        jitted = jax.jit(plan.kernel)
+                    self._jit_cache[key] = jitted
+                    self._note_compile("partials", metrics)
+                t0 = time.perf_counter()
+                with _span("dispatch", jit_cache_hit=hit,
+                           num_shards=mesh.devices.size if mesh else 1):
+                    consts_dev, seg_arg = self._args_for(plan, seg_mask,
+                                                         mesh)
+                    out = jitted(env, valid, seg_arg, consts_dev,
+                                 win[0]) if win is not None \
+                        else jitted(env, valid, seg_arg, consts_dev)
+                pin = self._pin_inflight(out)
+            # stage 2 (complete, lock-free): one device_get round trip
+            # of the whole output tree
+            with _span("host-transfer"):
+                out = self._fetch_tree(out, metrics, pin)
         metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
         metrics["jit_cache_hit"] = hit
         metrics["num_shards"] = mesh.devices.size if mesh else 1
-        return _embed_mask(out)
+        return self._embed_windowed_mask(out, plan, win, n_seg_full)
 
     def _args_for(self, plan: PhysicalPlan, seg_mask: np.ndarray, mesh):
         """Device copies of the per-call inputs (const pool + segment
@@ -1266,50 +1513,62 @@ class QueryRunner:
         sized retry if a run overflows its hint. Returns None only when
         the true group count exceeds the config cap (caller re-runs the
         unpacked per-array path)."""
-        env, valid, seg_mask = self._prepare(plan, metrics)
-        win = self._segment_window(plan, len(seg_mask))
-        if win is not None:
-            metrics["segments_window"] = win[1]
-        mesh = self.mesh
-        strategy = "historicals"
-        if mesh is not None:
-            from tpu_olap.planner import cost as cost_mod
-            with _span("cost-decision") as sp:
-                decision = cost_mod.decide(plan, self.config,
-                                           mesh.devices.size)
-                sp.set(strategy=decision.strategy)
-            strategy = decision.strategy
-            metrics["cost"] = decision.to_json()
-        cap_limit = min(self.config.result_group_cap, plan.total_groups)
-        base_key = plan.fingerprint() + (mesh.devices.size if mesh else 1,)
-        hint = self._cap_hints.get(base_key)
-        cap = cap_limit if hint is None else \
-            min(cap_limit, max(64, _next_pow2(2 * hint)))
+        with self._pipeline_slot():
+            with self._enqueue_lock(metrics):
+                env, valid, seg_mask = self._prepare(plan, metrics)
+                win = self._segment_window(plan, len(seg_mask))
+                if win is not None:
+                    metrics["segments_window"] = win[1]
+                mesh = self.mesh
+                strategy = "historicals"
+                if mesh is not None:
+                    from tpu_olap.planner import cost as cost_mod
+                    with _span("cost-decision") as sp:
+                        decision = cost_mod.decide(plan, self.config,
+                                                   mesh.devices.size)
+                        sp.set(strategy=decision.strategy)
+                    strategy = decision.strategy
+                    metrics["cost"] = decision.to_json()
+            cap_limit = min(self.config.result_group_cap,
+                            plan.total_groups)
+            base_key = plan.fingerprint() \
+                + (mesh.devices.size if mesh else 1,)
+            hint = self._cap_hints.get(base_key)
+            cap = cap_limit if hint is None else \
+                min(cap_limit, max(64, _next_pow2(2 * hint)))
 
-        t0 = time.perf_counter()
-        with _span("dispatch", packed=True) as dsp:
-            consts_dev, seg_arg = self._args_for(plan, seg_mask, mesh)
-            while True:
-                jitted, layout, hit = self._packed_jit(plan, cap, mesh,
-                                                       strategy, win)
-                if not hit:
-                    self._note_compile("packed", metrics)
-                buf = jitted(env, valid, seg_arg, consts_dev, win[0]) \
-                    if win is not None else \
-                    jitted(env, valid, seg_arg, consts_dev)
-                with _span("host-transfer"):
-                    self._inject("host-transfer")
-                    count, idx, compact = unpack(buf, layout)
-                if count <= layout.cap:
-                    break
-                if count > cap_limit:
-                    metrics["result_groups"] = count
-                    metrics["jit_cache_hit"] = hit
-                    dsp.set(jit_cache_hit=hit, overflow=True)
-                    return None  # config cap exceeded: unpacked re-run
-                cap = min(cap_limit, _next_pow2(count))
-            dsp.set(jit_cache_hit=hit,
-                    num_shards=mesh.devices.size if mesh else 1)
+            t0 = time.perf_counter()
+            with _span("dispatch", packed=True) as dsp:
+                while True:
+                    # stage 1 per attempt: jit/arg caches + the async
+                    # dispatch under the lock; a cap-overflow retry
+                    # re-enters it (rare — the hint adapts)
+                    with self._enqueue_lock(metrics):
+                        consts_dev, seg_arg = self._args_for(
+                            plan, seg_mask, mesh)
+                        jitted, layout, hit = self._packed_jit(
+                            plan, cap, mesh, strategy, win)
+                        if not hit:
+                            self._note_compile("packed", metrics)
+                        buf = jitted(env, valid, seg_arg, consts_dev,
+                                     win[0]) if win is not None else \
+                            jitted(env, valid, seg_arg, consts_dev)
+                        pin = self._pin_inflight(buf)
+                    # stage 2: the packed path's transfer is already a
+                    # single buffer — one round trip
+                    with _span("host-transfer"):
+                        buf = self._fetch_tree(buf, metrics, pin)
+                        count, idx, compact = unpack(buf, layout)
+                    if count <= layout.cap:
+                        break
+                    if count > cap_limit:
+                        metrics["result_groups"] = count
+                        metrics["jit_cache_hit"] = hit
+                        dsp.set(jit_cache_hit=hit, overflow=True)
+                        return None  # cap exceeded: unpacked re-run
+                    cap = min(cap_limit, _next_pow2(count))
+                dsp.set(jit_cache_hit=hit,
+                        num_shards=mesh.devices.size if mesh else 1)
         self._cap_hints[base_key] = count
         metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
         metrics["jit_cache_hit"] = hit
@@ -1335,9 +1594,18 @@ class QueryRunner:
         return out
 
     def _run_sparse_inner(self, plan: PhysicalPlan, metrics: dict):
+        with self._pipeline_slot():
+            return self._run_sparse_staged(plan, metrics)
+
+    def _run_sparse_staged(self, plan: PhysicalPlan, metrics: dict):
+        """Adaptive-cap sparse dispatch, two-staged: each attempt's jit
+        build + async dispatch runs under the enqueue lock; the _count
+        probe (a one-element sync) and the final whole-tree fetch run
+        lock-free, so an overflow retry re-enters stage 1."""
         from tpu_olap.kernels.groupby import UnsupportedAggregation
 
-        env, valid, seg_mask = self._prepare(plan, metrics)
+        with self._enqueue_lock(metrics):
+            env, valid, seg_mask = self._prepare(plan, metrics)
         win = self._segment_window(plan, len(seg_mask))
         if win is not None:
             metrics["segments_window"] = win[1]
@@ -1377,44 +1645,60 @@ class QueryRunner:
             metrics["num_shards"] = 1
         elif not use_exchange:
             import jax
-            consts_dev, seg_arg = self._args_for(plan, seg_mask, mesh)
-            while True:
-                key = base_key + (cap,) + ((win[1],) if win else ())
-                jitted = self._jit_cache.get(key)
-                hit = jitted is not None
-                if hit:
-                    _cache_lru_hit(self._jit_cache, key)
-                else:
-                    kern = plan.make_sparse_kernel(cap)
-                    if mesh is not None:
-                        from tpu_olap.executor.sharding import \
-                            sharded_sparse_gather_kernel
-                        jitted = jax.jit(sharded_sparse_gather_kernel(
-                            kern, plan, mesh, cap))
-                    elif win is not None:
-                        jitted = jax.jit(self._window_kernel(kern, win[1]))
-                    else:
-                        jitted = jax.jit(kern)
-                    self._jit_cache[key] = jitted
-                    self._note_compile("sparse", metrics)
-                out = jitted(env, valid, seg_arg, consts_dev, win[0]) \
-                    if win is not None else \
-                    jitted(env, valid, seg_arg, consts_dev)
-                count = int(out["_count"])
-                if count <= cap:
-                    break
-                if count > cap_limit:
-                    raise UnsupportedAggregation(
-                        f"{count} present groups exceed sparse budget "
-                        f"{cap_limit}")
-                cap = min(cap_limit, _next_pow2(count))
-            out = {k: np.asarray(v) for k, v in out.items()}
+            # pin the enqueued output tree like every other device path
+            # (the caller blocks on the _count probe while the buffers
+            # occupy HBM); a retry/raise unpins the superseded pin
+            pin = None
+            try:
+                while True:
+                    with self._enqueue_lock(metrics):
+                        consts_dev, seg_arg = self._args_for(
+                            plan, seg_mask, mesh)
+                        key = base_key + (cap,) \
+                            + ((win[1],) if win else ())
+                        jitted = self._jit_cache.get(key)
+                        hit = jitted is not None
+                        if hit:
+                            _cache_lru_hit(self._jit_cache, key)
+                        else:
+                            kern = plan.make_sparse_kernel(cap)
+                            if mesh is not None:
+                                from tpu_olap.executor.sharding import \
+                                    sharded_sparse_gather_kernel
+                                jitted = jax.jit(
+                                    sharded_sparse_gather_kernel(
+                                        kern, plan, mesh, cap))
+                            elif win is not None:
+                                jitted = jax.jit(
+                                    self._window_kernel(kern, win[1]))
+                            else:
+                                jitted = jax.jit(kern)
+                            self._jit_cache[key] = jitted
+                            self._note_compile("sparse", metrics)
+                        out = jitted(env, valid, seg_arg, consts_dev,
+                                     win[0]) if win is not None else \
+                            jitted(env, valid, seg_arg, consts_dev)
+                        prev, pin = pin, self._pin_inflight(out)
+                    if prev is not None:
+                        self._hbm_ledger.unpin_inflight(prev)
+                    count = int(out["_count"])
+                    if count <= cap:
+                        break
+                    if count > cap_limit:
+                        raise UnsupportedAggregation(
+                            f"{count} present groups exceed sparse "
+                            f"budget {cap_limit}")
+                    cap = min(cap_limit, _next_pow2(count))
+                out = self._fetch_tree(out, metrics, pin)
+                pin = None  # consumed (fetch unpins)
+            finally:
+                if pin is not None:
+                    self._hbm_ledger.unpin_inflight(pin)
             metrics["num_shards"] = n_shards
         else:
             import jax
             from tpu_olap.executor.sharding import \
                 sharded_sparse_exchange_kernel
-            consts_dev, seg_arg = self._args_for(plan, seg_mask, mesh)
             lhint = self._cap_hints.get(base_key + ("local",))
             if lhint is not None:
                 cap = min(local_limit, max(64, _next_pow2(2 * lhint)))
@@ -1422,43 +1706,57 @@ class QueryRunner:
             cap_owner = max(64, _next_pow2(2 * ohint)) if ohint \
                 else max(64, _next_pow2(-(-2 * cap // n_shards)))
             cap_owner = min(cap_owner, budget)
-            while True:
-                key = base_key + ("x", cap, cap_owner)
-                jitted = self._jit_cache.get(key)
-                hit = jitted is not None
-                if hit:
-                    _cache_lru_hit(self._jit_cache, key)
-                else:
-                    kern = plan.make_sparse_kernel(cap)
-                    jitted = jax.jit(sharded_sparse_exchange_kernel(
-                        kern, plan, mesh, cap, cap_owner))
-                    self._jit_cache[key] = jitted
-                    self._note_compile("sparse", metrics)
-                out = jitted(env, valid, seg_arg, consts_dev)
-                count = int(out["_count"])
-                local_max = int(out["_local_max"])
-                overflow = int(out["_overflow"])
-                retry = False
-                if local_max > cap:
-                    if local_max > local_limit:
-                        raise UnsupportedAggregation(
-                            f"{local_max} per-chip present groups exceed "
-                            f"sparse budget {local_limit}")
-                    cap = min(local_limit, _next_pow2(local_max))
-                    retry = True
-                if overflow:
-                    new_owner = min(budget, _next_pow2(max(
-                        2 * max(count, 1) // n_shards, 2 * cap_owner)))
-                    if new_owner == cap_owner:  # already at the clamp
-                        raise UnsupportedAggregation(
-                            f"owner tables overflow the per-chip sparse "
-                            f"budget {budget} ({count}+ present groups "
-                            f"over {n_shards} chips)")
-                    cap_owner = new_owner
-                    retry = True
-                if not retry:
-                    break
-            out = {k: np.asarray(v) for k, v in out.items()}
+            pin = None
+            try:
+                while True:
+                    with self._enqueue_lock(metrics):
+                        consts_dev, seg_arg = self._args_for(
+                            plan, seg_mask, mesh)
+                        key = base_key + ("x", cap, cap_owner)
+                        jitted = self._jit_cache.get(key)
+                        hit = jitted is not None
+                        if hit:
+                            _cache_lru_hit(self._jit_cache, key)
+                        else:
+                            kern = plan.make_sparse_kernel(cap)
+                            jitted = jax.jit(
+                                sharded_sparse_exchange_kernel(
+                                    kern, plan, mesh, cap, cap_owner))
+                            self._jit_cache[key] = jitted
+                            self._note_compile("sparse", metrics)
+                        out = jitted(env, valid, seg_arg, consts_dev)
+                        prev, pin = pin, self._pin_inflight(out)
+                    if prev is not None:
+                        self._hbm_ledger.unpin_inflight(prev)
+                    count = int(out["_count"])
+                    local_max = int(out["_local_max"])
+                    overflow = int(out["_overflow"])
+                    retry = False
+                    if local_max > cap:
+                        if local_max > local_limit:
+                            raise UnsupportedAggregation(
+                                f"{local_max} per-chip present groups "
+                                f"exceed sparse budget {local_limit}")
+                        cap = min(local_limit, _next_pow2(local_max))
+                        retry = True
+                    if overflow:
+                        new_owner = min(budget, _next_pow2(max(
+                            2 * max(count, 1) // n_shards,
+                            2 * cap_owner)))
+                        if new_owner == cap_owner:  # at the clamp
+                            raise UnsupportedAggregation(
+                                f"owner tables overflow the per-chip "
+                                f"sparse budget {budget} ({count}+ "
+                                f"present groups over {n_shards} chips)")
+                        cap_owner = new_owner
+                        retry = True
+                    if not retry:
+                        break
+                out = self._fetch_tree(out, metrics, pin)
+                pin = None  # consumed (fetch unpins)
+            finally:
+                if pin is not None:
+                    self._hbm_ledger.unpin_inflight(pin)
             self._cap_hints[base_key + ("local",)] = local_max
             self._cap_hints[base_key + ("owner",)] = \
                 max(64, count // n_shards)
@@ -1639,60 +1937,64 @@ class QueryRunner:
         mergeable partials dict ({segment id: partials}). One compiled
         program per (template, W) serves ANY to-compute subset — the
         subset rides in through the seg-mask runtime argument."""
-        env, valid, _ = self._prepare(plan, metrics)
-        table = plan.table
-        ds = self._dataset(table)
-        seg_mask = ds.segment_mask(compute_ids)
-        # honest scan accounting: only the computed segments are read
-        metrics["segments_scanned"] = len(compute_ids)
-        metrics["rows_scanned"] = int(sum(
-            table.segments[i].meta.n_valid for i in compute_ids))
-        S = len(seg_mask)
-        K = plan.total_groups
-        lo, hi = min(compute_ids), max(compute_ids) + 1
-        t0 = time.perf_counter()
-        if self.config.platform == "cpu":
-            W = hi - lo
-            with _span("dispatch", jit_cache_hit=False, segcache=True,
-                       num_shards=1):
-                wenv, wvalid, wmask = self._window_numpy(
-                    env, np.asarray(valid), seg_mask, (lo, W))
-                fenv, mask, key = plan.key_fn(wenv, wvalid, wmask,
-                                              plan.pool.consts)
-                from tpu_olap.kernels.groupby import group_reduce
-                r = mask.size // W
-                key2 = (np.repeat(np.arange(W, dtype=np.int64), r)
-                        * K + key.astype(np.int64))
-                out = group_reduce(key2, mask, fenv, plan.agg_plans,
-                                   W * K, plan.pool.consts)
-            out = {k: np.asarray(v) for k, v in out.items()}
-            metrics["jit_cache_hit"] = False
-            metrics["num_shards"] = 1
-        else:
-            import jax
-            W = min(_next_pow2(hi - lo), S)
-            lo = min(lo, S - W)
-            jkey = plan.fingerprint() + ("segcache", W)
-            jitted = self._jit_cache.get(jkey)
-            hit = jitted is not None
-            if hit:
-                _cache_lru_hit(self._jit_cache, jkey)
-            else:
-                jitted = jax.jit(
-                    self._seg_partials_kernel(plan, W, K))
-                self._jit_cache[jkey] = jitted
-                self._note_compile("segcache", metrics)
-            with _span("dispatch", jit_cache_hit=hit, segcache=True,
-                       num_shards=1):
-                consts_dev, seg_arg = self._args_for(plan, seg_mask,
-                                                     None)
-                out = jitted(env, valid, seg_arg, consts_dev, lo)
-            with _span("host-transfer"):
-                self._inject("host-transfer")
+        with self._pipeline_slot():
+            with self._enqueue_lock(metrics):
+                env, valid, _ = self._prepare(plan, metrics)
+                table = plan.table
+                ds = self._dataset(table)
+                seg_mask = ds.segment_mask(compute_ids)
+            # honest scan accounting: only the computed segments are read
+            metrics["segments_scanned"] = len(compute_ids)
+            metrics["rows_scanned"] = int(sum(
+                table.segments[i].meta.n_valid for i in compute_ids))
+            S = len(seg_mask)
+            K = plan.total_groups
+            lo, hi = min(compute_ids), max(compute_ids) + 1
+            t0 = time.perf_counter()
+            if self.config.platform == "cpu":
+                W = hi - lo
+                with _span("dispatch", jit_cache_hit=False, segcache=True,
+                           num_shards=1):
+                    wenv, wvalid, wmask = self._window_numpy(
+                        env, np.asarray(valid), seg_mask, (lo, W))
+                    fenv, mask, key = plan.key_fn(wenv, wvalid, wmask,
+                                                  plan.pool.consts)
+                    from tpu_olap.kernels.groupby import group_reduce
+                    r = mask.size // W
+                    key2 = (np.repeat(np.arange(W, dtype=np.int64), r)
+                            * K + key.astype(np.int64))
+                    out = group_reduce(key2, mask, fenv, plan.agg_plans,
+                                       W * K, plan.pool.consts)
                 out = {k: np.asarray(v) for k, v in out.items()}
-            metrics["jit_cache_hit"] = hit
-            metrics["num_shards"] = 1
-        metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
+                metrics["jit_cache_hit"] = False
+                metrics["num_shards"] = 1
+            else:
+                import jax
+                W = min(_next_pow2(hi - lo), S)
+                lo = min(lo, S - W)
+                with self._enqueue_lock(metrics):
+                    jkey = plan.fingerprint() + ("segcache", W)
+                    jitted = self._jit_cache.get(jkey)
+                    hit = jitted is not None
+                    if hit:
+                        _cache_lru_hit(self._jit_cache, jkey)
+                    else:
+                        jitted = jax.jit(
+                            self._seg_partials_kernel(plan, W, K))
+                        self._jit_cache[jkey] = jitted
+                        self._note_compile("segcache", metrics)
+                    with _span("dispatch", jit_cache_hit=hit,
+                               segcache=True, num_shards=1):
+                        consts_dev, seg_arg = self._args_for(
+                            plan, seg_mask, None)
+                        out = jitted(env, valid, seg_arg, consts_dev,
+                                     lo)
+                    pin = self._pin_inflight(out)
+                with _span("host-transfer"):
+                    out = self._fetch_tree(out, metrics, pin)
+                metrics["jit_cache_hit"] = hit
+                metrics["num_shards"] = 1
+            metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
         shaped = {name: arr.reshape((W, K) + arr.shape[1:])
                   for name, arr in out.items()}
         return {sid: {name: arr[sid - lo]
@@ -2007,25 +2309,34 @@ class QueryRunner:
             # padded past the segment stack (shard-multiple rounding) —
             # slice, never the reverse (the kernels mask pruned
             # segments in place rather than compacting them away)
-            ds = self._dataset(table)
-            cards = tuple(table.dictionaries[d].cardinality
-                          for d in coded)
-            pins = frozenset((table.name, "col", d) for d in coded)
-            cols = tuple(ds.col(d, pins) for d in coded)
-            n_flat = cols[0].size
-            dev_mask = partials["mask"]
-            if dev_mask.size < n_flat:
-                raise AssertionError(
-                    "search mask shorter than the segment stack")
-            if self.config.platform == "cpu":
-                m = np.asarray(dev_mask).reshape(-1)[:n_flat]
-                packed = np.concatenate(
-                    [np.bincount(np.asarray(c).reshape(-1)[m],
-                                 minlength=card + 1)
-                     for c, card in zip(cols, cards)])
-            else:
-                packed = np.asarray(_search_counts_packed(
-                    cards, dev_mask.reshape(-1)[:n_flat], cols))
+            with self._pipeline_slot():
+                # the column fetch mutates the dataset cache and the
+                # counts program is a device dispatch: both stage-1
+                # work; the host bincounts / transfer run lock-free
+                with self._enqueue_lock(metrics):
+                    ds = self._dataset(table)
+                    cards = tuple(table.dictionaries[d].cardinality
+                                  for d in coded)
+                    pins = frozenset((table.name, "col", d)
+                                     for d in coded)
+                    cols = tuple(ds.col(d, pins) for d in coded)
+                    n_flat = cols[0].size
+                    dev_mask = partials["mask"]
+                    if dev_mask.size < n_flat:
+                        raise AssertionError(
+                            "search mask shorter than the segment stack")
+                    packed_dev = None
+                    if self.config.platform != "cpu":
+                        packed_dev = _search_counts_packed(
+                            cards, dev_mask.reshape(-1)[:n_flat], cols)
+                if packed_dev is None:
+                    m = np.asarray(dev_mask).reshape(-1)[:n_flat]
+                    packed = np.concatenate(
+                        [np.bincount(np.asarray(c).reshape(-1)[m],
+                                     minlength=card + 1)
+                         for c, card in zip(cols, cards)])
+                else:
+                    packed = np.asarray(packed_dev)
             off = 0
             for dim, card in zip(coded, cards):
                 d = table.dictionaries[dim]

@@ -51,7 +51,7 @@ class AdmissionController:
     """
 
     def __init__(self, max_inflight: int, queue_limit: int,
-                 metrics=None, events=None):
+                 metrics=None, events=None, pipeline_depth: int = 0):
         self.max_inflight = int(max_inflight)
         self.queue_limit = max(0, int(queue_limit))
         self._events = events  # obs.events.EventLog (optional)
@@ -60,7 +60,17 @@ class AdmissionController:
         self._queued = 0
         self._service_ewma_s = _EWMA_SEED_S
         self._local = threading.local()  # re-entrancy guard
+        # pipelined execution (EngineConfig.pipeline_depth): bounds how
+        # many dispatches may sit between stage-1 enqueue and stage-2
+        # completion at once, so queued device work and pinned result
+        # buffers stay within the HBM budget. Independent of the
+        # max_inflight dispatch-slot bound (admission off still bounds
+        # the pipeline); 0 disables the gate.
+        self.pipeline_depth = max(0, int(pipeline_depth))
+        self._p_cond = threading.Condition()
+        self._p_inflight = 0
         self._m_shed = self._m_depth = self._m_wait = None
+        self._m_pipeline = None
         if metrics is not None:
             from tpu_olap.obs.metrics import QUEUE_WAIT_BUCKETS_MS
             self._m_shed = metrics.counter(
@@ -73,17 +83,26 @@ class AdmissionController:
                 "admission_queue_wait_ms",
                 "Wait for a dispatch slot (admitted queries only).",
                 buckets=QUEUE_WAIT_BUCKETS_MS)
+            self._m_pipeline = metrics.gauge(
+                "pipeline_inflight",
+                "Dispatches between stage-1 enqueue and stage-2 "
+                "completion (pipelined execution occupancy).")
             self._m_depth.set(0)
+            self._m_pipeline.set(0)
 
     # ------------------------------------------------------------ stats
 
     def snapshot(self) -> dict:
         with self._cond:
-            return {"inflight": self._inflight, "queued": self._queued,
-                    "max_inflight": self.max_inflight,
-                    "queue_limit": self.queue_limit,
-                    "service_ewma_ms": round(
-                        self._service_ewma_s * 1000, 3)}
+            out = {"inflight": self._inflight, "queued": self._queued,
+                   "max_inflight": self.max_inflight,
+                   "queue_limit": self.queue_limit,
+                   "service_ewma_ms": round(
+                       self._service_ewma_s * 1000, 3)}
+        with self._p_cond:
+            out["pipeline_depth"] = self.pipeline_depth
+            out["pipeline_inflight"] = self._p_inflight
+        return out
 
     def _expected_wait_s(self) -> float:
         """Coarse queue-wait estimate under the lock: everyone ahead of
@@ -187,3 +206,71 @@ class AdmissionController:
                     self._m_depth.set(self._queued)
             self._inflight += 1
             return (time.perf_counter() - t0) * 1000
+
+    # --------------------------------------------------------- pipeline
+
+    @contextmanager
+    def pipeline_slot(self, budget_s: float | None = None):
+        """Hold one in-flight pipeline slot for the body (stage-1
+        enqueue through stage-2 completion of one device dispatch).
+        Bounds queued device work + pinned result buffers at
+        pipeline_depth; a waiter whose deadline budget expires before a
+        slot frees is shed (the dispatch was doomed anyway). Re-entrant
+        per thread, like slot(): a path that re-enters the runner never
+        deadlocks on its own pipeline slot. Disabled (depth 0) -> no-op.
+        """
+        if self.pipeline_depth <= 0 or getattr(self._local, "p_held", 0):
+            yield
+            return
+        try:
+            self._p_admit(budget_s)
+        except QueryShed as e:
+            self._emit_shed(e)  # outside the cond, like slot()
+            raise
+        self._local.p_held = 1
+        try:
+            yield
+        finally:
+            self._local.p_held = 0
+            with self._p_cond:
+                # clamp: reset_pipeline may have reclaimed this slot
+                # while its (abandoned) holder was still running
+                self._p_inflight = max(0, self._p_inflight - 1)
+                if self._m_pipeline is not None:
+                    self._m_pipeline.set(self._p_inflight)
+                self._p_cond.notify()
+
+    def reset_pipeline(self):
+        """Reclaim in-flight pipeline slots stranded by deadline-
+        abandoned dispatch threads — called from wedge recovery once
+        the device has been probed healthy and its state purged
+        (QueryRunner._recover_after_probe). Without this, pipeline_depth
+        hung dispatches would permanently zero the engine's device
+        capacity even after the device heals. A stranded worker that
+        later wakes releases a slot that was already reclaimed; the
+        release clamps at zero, so the worst case is a transiently
+        over-admitted dispatch, not permanent starvation."""
+        with self._p_cond:
+            if self._p_inflight:
+                self._p_inflight = 0
+                if self._m_pipeline is not None:
+                    self._m_pipeline.set(0)
+                self._p_cond.notify_all()
+
+    def _p_admit(self, budget_s: float | None):
+        with self._p_cond:
+            deadline = None if budget_s is None \
+                else time.perf_counter() + budget_s
+            while self._p_inflight >= self.pipeline_depth:
+                timeout = None
+                if deadline is not None:
+                    timeout = deadline - time.perf_counter()
+                    if timeout <= 0:
+                        self._shed(
+                            "pipeline_stall",
+                            "deadline budget exhausted waiting for an "
+                            "in-flight pipeline slot")
+                self._p_cond.wait(timeout)
+            self._p_inflight += 1
+            if self._m_pipeline is not None:
+                self._m_pipeline.set(self._p_inflight)
