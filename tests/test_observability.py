@@ -267,7 +267,10 @@ def test_metrics_endpoint_prometheus_grammar():
                  "tpu_olap_segments_scanned_total",
                  "tpu_olap_compile_cache_requests_total",
                  "tpu_olap_batch_size_count",
-                 "tpu_olap_history_records"):
+                 "tpu_olap_history_records",
+                 # workload profiler families (ISSUE 11 satellite)
+                 "tpu_olap_workload_templates",
+                 "tpu_olap_workload_observations_total"):
         assert name in seen, f"{name} missing from /metrics"
     # latency histogram covers the paths this workload exercised
     for path in ("dense", "fallback", "batch"):
@@ -361,3 +364,265 @@ def test_ssb_explain_analyze_sums():
     rec = eng.history[-1]
     assert rec["query_type"] in ("groupBy", "topN", "timeseries")
     assert rec["total_ms"] <= root_ms * 1.05 + 1.0
+
+
+# -------------------------------------- workload introspection (ISSUE 11)
+
+
+def test_sub_ms_latency_buckets():
+    """Warm-cache serves (~0.6 ms, BENCH_CACHE.json) must not collapse
+    into one bucket: the histogram head now resolves 0.1/0.25/0.5 so
+    cache-path p50 and p95 are distinguishable (ISSUE 11 satellite)."""
+    from tpu_olap.obs.metrics import LATENCY_BUCKETS_MS, MetricsRegistry
+    assert LATENCY_BUCKETS_MS[:4] == (0.1, 0.25, 0.5, 1.0)
+    reg = MetricsRegistry("test")
+    h = reg.histogram("warm_ms")
+    for v in (0.2, 0.2, 0.2, 0.2, 0.8):
+        h.observe(v)
+    p50, p95 = h.quantile(0.5), h.quantile(0.95)
+    assert p50 is not None and p50 <= 0.25
+    assert p95 is not None and p95 > 0.5
+
+
+def test_template_fingerprint_stability():
+    """Same query with different WHERE literals / time intervals -> one
+    template; changed dims or aggs -> different templates; fallback
+    statements fingerprint from literal-masked SQL the same way."""
+    eng = _engine()
+    base = ("SELECT g, sum(v) AS s FROM t WHERE v > {lit} "
+            "AND ts >= '{t0}' GROUP BY g")
+    eng.sql(base.format(lit=100, t0="2023-03-05"))
+    t_a = eng.history[-1]["template_id"]
+    eng.sql(base.format(lit=700, t0="2023-04-01"))
+    assert eng.history[-1]["template_id"] == t_a
+    eng.sql("SELECT h, sum(v) AS s FROM t WHERE v > 100 GROUP BY h")
+    t_dims = eng.history[-1]["template_id"]
+    eng.sql("SELECT g, min(v) AS s FROM t WHERE v > 100 GROUP BY g")
+    t_aggs = eng.history[-1]["template_id"]
+    assert len({t_a, t_dims, t_aggs}) == 3
+
+    eng.register_table("dim", pd.DataFrame({"k": [1, 2, 3]}),
+                       accelerate=False)
+    eng.sql("SELECT k FROM dim WHERE k > 1")
+    t_f1 = eng.history[-1]["template_id"]
+    eng.sql("SELECT k FROM dim WHERE k > 2")
+    assert eng.history[-1]["template_id"] == t_f1
+    assert eng.history[-1]["path"] == "fallback"
+
+
+def test_template_fingerprint_survives_batch_and_coalescer():
+    """The same logical template keeps one id across the single-query
+    path, fused batch legs, dedup fan-outs, and coalesced concurrent
+    submissions (ISSUE 11 satellite)."""
+    import threading
+    eng = _engine()
+    q_a = "SELECT g, sum(v) AS s FROM t WHERE v > {lit} GROUP BY g"
+    q_b = "SELECT h, count(*) AS n FROM t WHERE v < {lit} GROUP BY h"
+    eng.sql(q_a.format(lit=10))
+    t_a = eng.history[-1]["template_id"]
+    eng.sql(q_b.format(lit=990))
+    t_b = eng.history[-1]["template_id"]
+
+    h0 = len(eng.history)
+    eng.sql_batch([q_a.format(lit=200), q_b.format(lit=300),
+                   q_a.format(lit=400), q_a.format(lit=400)])
+    recs = list(eng.history)[h0:]
+    assert len(recs) == 4
+    assert {r["template_id"] for r in recs} == {t_a, t_b}
+    dedups = [r for r in recs if r.get("batch_dedup")]
+    assert dedups and all(r["template_id"] == t_a for r in dedups)
+
+    # coalescer: concurrent same-template callers ride one fused
+    # dispatch and still attribute to their shared template
+    ceng = _engine(batch_window_ms=40.0)
+    ceng.sql(q_a.format(lit=10))
+    t_ca = ceng.history[-1]["template_id"]
+    h0 = len(ceng.history)
+    barrier = threading.Barrier(4)
+
+    def client(lit):
+        barrier.wait()
+        ceng.sql(q_a.format(lit=lit))
+
+    threads = [threading.Thread(target=client, args=(100 + i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    recs = list(ceng.history)[h0:]
+    assert len(recs) == 4
+    assert all(r["template_id"] == t_ca for r in recs)
+
+
+def _mixed_workload(eng):
+    qs = [
+        "SELECT g, sum(v) AS s FROM t WHERE v > 100 GROUP BY g",
+        "SELECT g, sum(v) AS s FROM t WHERE v > 500 GROUP BY g",
+        "SELECT g, sum(v) AS s FROM t WHERE v > 100 GROUP BY g",  # warm
+        "SELECT g, sum(v) AS s FROM t WHERE v > 100 GROUP BY g",  # warm
+        "SELECT h, max(v) AS m FROM t GROUP BY h",
+        "SELECT sum(v) AS s, count(*) AS n FROM t",
+        "SELECT sum(v) AS s, count(*) AS n FROM t",               # warm
+    ]
+    for q in qs:
+        eng.sql(q)
+
+
+def test_sys_query_templates_matches_history_ground_truth():
+    """ISSUE 11 acceptance: SELECT ... FROM sys.query_templates ORDER BY
+    count DESC LIMIT 5 executes through the ordinary Engine.sql path
+    after a mixed run, and every stat matches ground truth derived from
+    QueryRunner.history; introspection appears nowhere in its own
+    stats."""
+    from tpu_olap.obs.workload import percentile
+    eng = _engine(result_cache_enabled=True)
+    eng.register_table("dim", pd.DataFrame({"k": [1, 2, 3]}),
+                       accelerate=False)
+    _mixed_workload(eng)
+    eng.sql("SELECT k FROM dim WHERE k > 1")
+
+    by_template: dict = {}
+    for rec in eng.history:
+        by_template.setdefault(rec["template_id"], []).append(rec)
+    n_hist = len(eng.history)
+    n_templates = len(eng.runner.workload.snapshot())
+
+    top = eng.sql("SELECT * FROM sys.query_templates "
+                  "ORDER BY count DESC LIMIT 5")
+    assert 1 <= len(top) <= 5
+    counts = list(top["count"])
+    assert counts == sorted(counts, reverse=True)
+    for _, row in top.iterrows():
+        recs = by_template[row["template_id"]]
+        assert row["count"] == len(recs)
+        lats = [r["total_ms"] for r in recs]
+        assert row["p50_ms"] == pytest.approx(percentile(lats, 0.50))
+        assert row["p95_ms"] == pytest.approx(percentile(lats, 0.95))
+        assert row["p99_ms"] == pytest.approx(percentile(lats, 0.99))
+        hits = sum(1 for r in recs if r.get("cache_hit"))
+        assert row["cache_hit_rate"] == pytest.approx(hits / len(recs))
+        assert row["rows_scanned"] == \
+            sum(r.get("rows_scanned") or 0 for r in recs)
+
+    # no recursion: the introspection query left no record, no
+    # template, no counter increment anywhere
+    assert len(eng.history) == n_hist
+    assert len(eng.runner.workload.snapshot()) == n_templates
+    n1 = int(eng.sql("SELECT COUNT(*) AS n FROM sys.queries")["n"][0])
+    n2 = int(eng.sql("SELECT COUNT(*) AS n FROM sys.queries")["n"][0])
+    assert n1 == n2 == n_hist
+    assert eng.counters()["queries"] == n_hist
+    assert not any(str(r["datasource"]).startswith("sys.")
+                   for r in eng.runner.workload.snapshot())
+
+
+def test_sys_schema_surfaces():
+    """sys.tables / sys.segments / sys.caches / sys.metrics /
+    sys.queries answer through ordinary SQL with live engine state."""
+    eng = _engine(result_cache_enabled=True)
+    _mixed_workload(eng)
+
+    tables = eng.sql("SELECT * FROM sys.tables")
+    row = tables[tables["table"] == "t"].iloc[0]
+    assert bool(row["accelerated"]) and int(row["rows"]) == 6000
+
+    segs = eng.sql("SELECT * FROM sys.segments WHERE table = 't'")
+    assert int(segs["rows"].sum()) == 6000
+    assert (segs["time_min"] <= segs["time_max"]).all()
+
+    caches = eng.sql("SELECT * FROM sys.caches")
+    assert {"full", "segment", "jit", "plan", "arg"} \
+        <= set(caches["cache"])
+
+    metrics = eng.sql("SELECT * FROM sys.metrics "
+                      "WHERE name = 'tpu_olap_queries_total'")
+    assert len(metrics) >= 1 and metrics["value"].sum() > 0
+
+    # sys.queries joins back to sys.query_templates on template_id
+    joined = eng.sql(
+        "SELECT q.template_id, COUNT(*) AS n FROM sys.queries q "
+        "GROUP BY q.template_id ORDER BY n DESC")
+    assert int(joined["n"].sum()) == len(eng.history)
+
+    with pytest.raises(KeyError):
+        eng.sql("SELECT * FROM sys.not_a_table")
+
+    # a sys reference inside an expression subquery routes the WHOLE
+    # statement onto the suppressed introspection path too
+    n_hist = len(eng.history)
+    n_templates = len(eng.runner.workload.snapshot())
+    out = eng.sql("SELECT g FROM t WHERE v IN "
+                  "(SELECT rows_returned FROM sys.queries) GROUP BY g")
+    assert len(eng.history) == n_hist
+    assert len(eng.runner.workload.snapshot()) == n_templates
+    assert not any(str(r["datasource"]).startswith("sys.")
+                   for r in eng.runner.workload.snapshot())
+
+    # a sys self-join reads ONE consistent snapshot per statement
+    # (both sides resolve the same memoized entry — no row ever
+    # present on one side and missing from the other)
+    joined = eng.sql(
+        "SELECT COUNT(*) AS n FROM "
+        "(SELECT query_id FROM sys.queries) a JOIN "
+        "(SELECT query_id AS qid2 FROM sys.queries) b "
+        "ON a.query_id = b.qid2")
+    assert int(joined["n"][0]) == n_hist
+
+
+def test_x_query_id_header_and_debug_workload():
+    """POST /sql answers with an X-Query-Id correlating to the history
+    record; /sql/batch carries one id per statement; GET /debug/workload
+    serves the profiler + cube-advisor recommendations."""
+    eng = _engine()
+    _mixed_workload(eng)
+    srv = QueryServer(eng).start()
+    try:
+        req = urllib.request.Request(
+            srv.url + "/sql",
+            data=json.dumps({"query": GROUP_SQL}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            qid = r.headers.get("X-Query-Id")
+            json.loads(r.read())
+        assert qid and qid == eng.history[-1]["query_id"]
+
+        req = urllib.request.Request(
+            srv.url + "/sql/batch",
+            data=json.dumps({"queries": [GROUP_SQL, AGG_SQL]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            ids = (r.headers.get("X-Query-Id") or "").split(",")
+            json.loads(r.read())
+        assert len(ids) == 2 and all(i.startswith("q") for i in ids)
+
+        # a sys statement in a batch: no dangling id (its slot is "-")
+        # and no introspection spans leak into the batch trace
+        req = urllib.request.Request(
+            srv.url + "/sql/batch",
+            data=json.dumps({"queries": [
+                "SELECT COUNT(*) AS n FROM sys.queries",
+                AGG_SQL]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            ids = (r.headers.get("X-Query-Id") or "").split(",")
+            body = json.loads(r.read())
+        assert ids[0] == "-" and ids[1].startswith("q")
+        assert body["results"][0]["rows"][0]["n"] > 0
+        batch_trace = eng.tracer.last
+        names = {s.name for _, s in batch_trace.walk()}
+        assert not any(n.startswith("fallback") for n in names), names
+
+        _, body = _get(srv.url + "/debug/workload")
+        snap = json.loads(body)
+        assert snap["totals"]["observations"] >= 7
+        assert snap["templates"], "no templates in /debug/workload"
+        top = snap["templates"][0]
+        assert {"template_id", "count", "p50_ms", "p95_ms",
+                "cache_hit_rate", "dims"} <= set(top)
+        assert snap["recommendations"], "no rollup recommendations"
+        rec = snap["recommendations"][0]
+        assert {"datasource", "dims", "granularity", "queries",
+                "est_ms_saved", "templates"} <= set(rec)
+    finally:
+        srv.stop()

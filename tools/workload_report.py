@@ -1,0 +1,174 @@
+"""Workload report — the cube advisor's input artifact (ISSUE 11).
+
+Prints the query-template profile (obs.workload): top templates by
+count with latency percentiles, cache hit-rates, grouping dims, and
+time-granularity histograms, followed by the ranked rollup-grain
+recommendations — the literal (datasource, dim-set, grain) demand
+signal ROADMAP item 1's cube materializer consumes.
+
+Three sources:
+
+    python tools/workload_report.py --url http://host:port
+        Fetch GET /debug/workload from a live QueryServer.
+    python tools/workload_report.py --selftest
+        Build an in-process engine, run a small mixed SSB-shaped
+        workload (repeats, literal variations, a fallback statement,
+        warm cache hits), then report from the engine itself AND
+        assert the sys.* introspection surface answers — the CI
+        workload-smoke gate. Exits non-zero when the profile or
+        `SELECT COUNT(*) FROM sys.queries` comes back empty.
+    ... --json   emit the raw payload as JSON instead of the table.
+"""
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _fetch(url: str) -> dict:
+    with urllib.request.urlopen(url.rstrip("/") + "/debug/workload",
+                                timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _fmt_ms(v) -> str:
+    return "-" if v is None else f"{float(v):.2f}"
+
+
+def render(payload: dict, top: int = 10) -> str:
+    lines = []
+    totals = payload.get("totals", {})
+    lines.append(
+        f"workload profile: {totals.get('templates', 0)} templates, "
+        f"{totals.get('observations', 0)} observations")
+    lines.append("")
+    lines.append("top query templates (by count):")
+    header = (f"  {'template':<13}{'count':>6}{'p50ms':>9}{'p95ms':>9}"
+              f"{'p99ms':>9}{'hit%':>6}  {'type':<11}{'datasource':<14}"
+              f"{'grain':<7}dims")
+    lines.append(header)
+    for r in payload.get("templates", [])[:top]:
+        grains = json.loads(r.get("granularities") or "{}")
+        grain = max(grains, key=grains.get) if grains else "-"
+        hitpct = 100.0 * float(r.get("cache_hit_rate") or 0.0)
+        lines.append(
+            f"  {r['template_id']:<13}{r['count']:>6}"
+            f"{_fmt_ms(r.get('p50_ms')):>9}{_fmt_ms(r.get('p95_ms')):>9}"
+            f"{_fmt_ms(r.get('p99_ms')):>9}{hitpct:>5.0f}%"
+            f"  {r.get('query_type', '?'):<11}"
+            f"{r.get('datasource', '?'):<14}{grain:<7}"
+            f"{r.get('dims') or '-'}")
+    lines.append("")
+    lines.append("recommended rollup grains (cube advisor input, "
+                 "ranked by wall spent):")
+    recs = payload.get("recommendations", [])
+    if not recs:
+        lines.append("  (no aggregate templates observed yet)")
+    for i, g in enumerate(recs, 1):
+        dims = ",".join(g.get("dims") or []) or "(global)"
+        lines.append(
+            f"  {i}. {g.get('datasource')}: dims [{dims}] @ "
+            f"{g.get('granularity')} — {g.get('queries')} queries, "
+            f"~{g.get('est_ms_saved', 0.0):.1f} ms total wall "
+            f"({len(g.get('templates', []))} templates)")
+    return "\n".join(lines)
+
+
+def _selftest_payload():
+    """In-process engine + a small mixed SSB-shaped workload; returns
+    (payload, engine). Asserts the sys.* surface answers through the
+    engine's own SQL — the CI workload-smoke contract."""
+    from tpu_olap.utils.platform import force_cpu_devices
+    force_cpu_devices(1)
+    import numpy as np
+    import pandas as pd
+    from tpu_olap import Engine
+    from tpu_olap.executor import EngineConfig
+    from tpu_olap.obs.workload import recommend_rollups
+
+    rng = np.random.default_rng(42)
+    n = 50_000
+    lineorder = pd.DataFrame({
+        "lo_orderdate": pd.to_datetime("1995-01-01") + pd.to_timedelta(
+            rng.integers(0, 365 * 2, n), unit="D"),
+        "lo_quantity": rng.integers(1, 50, n).astype(np.int64),
+        "lo_extendedprice": rng.integers(100, 50_000, n).astype(np.int64),
+        "lo_discount": rng.integers(0, 10, n).astype(np.int64),
+        "p_category": rng.choice(
+            [f"MFGR#{i}" for i in range(1, 6)], n),
+        "s_region": rng.choice(
+            ["AMERICA", "ASIA", "EUROPE", "AFRICA"], n),
+    })
+    eng = Engine(EngineConfig(result_cache_enabled=True,
+                              segment_cache_enabled=True))
+    eng.register_table("lineorder", lineorder,
+                       time_column="lo_orderdate")
+
+    q1 = ("SELECT sum(lo_extendedprice * lo_discount) AS revenue "
+          "FROM lineorder WHERE year(lo_orderdate) = {y} "
+          "AND lo_discount >= 1 AND lo_discount <= 3 "
+          "AND lo_quantity < 25")
+    q2 = ("SELECT s_region, sum(lo_extendedprice) AS rev "
+          "FROM lineorder WHERE lo_discount > {d} GROUP BY s_region "
+          "ORDER BY rev DESC")
+    q3 = ("SELECT year(lo_orderdate) AS y, p_category, "
+          "sum(lo_extendedprice) AS rev FROM lineorder "
+          "GROUP BY year(lo_orderdate), p_category ORDER BY y")
+    for y in (1995, 1996, 1995):        # literal variants + a repeat
+        eng.sql(q1.format(y=y))
+    for d in (2, 5, 2, 2):              # the last two are cache-warm
+        eng.sql(q2.format(d=d))
+    eng.sql(q3)
+    eng.sql_batch([q2.format(d=2), q3, q1.format(y=1995)])
+    # one interpreter-path statement so fallback templates appear too
+    eng.sql("SELECT p_category, rank() OVER (ORDER BY sum(lo_quantity) "
+            "DESC) AS r FROM lineorder GROUP BY p_category")
+
+    n_queries = int(eng.sql(
+        "SELECT COUNT(*) AS n FROM sys.queries")["n"][0])
+    top = eng.sql("SELECT template_id, count, p50_ms FROM "
+                  "sys.query_templates ORDER BY count DESC LIMIT 5")
+    if n_queries == 0 or len(top) == 0:
+        raise SystemExit(
+            f"workload selftest FAILED: sys.queries={n_queries} rows, "
+            f"sys.query_templates={len(top)} rows")
+    rows = eng.runner.workload.snapshot()
+    payload = {"totals": eng.runner.workload.totals(),
+               "templates": rows,
+               "recommendations": recommend_rollups(rows)}
+    print(f"selftest: {n_queries} recorded queries, "
+          f"{len(rows)} templates, sys.* surface OK\n")
+    return payload
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Print the query-template workload profile and "
+                    "rollup-cube recommendations.")
+    p.add_argument("--url", help="live QueryServer base URL "
+                                 "(reads GET /debug/workload)")
+    p.add_argument("--selftest", action="store_true",
+                   help="CI smoke: in-process engine + SSB-shaped "
+                        "workload, asserts sys.* answers non-empty")
+    p.add_argument("--top", type=int, default=10,
+                   help="templates to print (default 10)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the raw payload as JSON")
+    args = p.parse_args(argv)
+    if bool(args.url) == bool(args.selftest):
+        p.error("pass exactly one of --url or --selftest")
+    payload = _fetch(args.url) if args.url else _selftest_payload()
+    if args.json:
+        print(json.dumps(payload, indent=2, default=str))
+    else:
+        print(render(payload, args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,7 +11,10 @@ import time
 import numpy as np
 import pandas as pd
 
-from tpu_olap.catalog import Catalog, StarSchema, TableEntry
+from tpu_olap.catalog import (Catalog, StarSchema, SysTableProvider,
+                              TableEntry, stmt_uses_sys)
+from tpu_olap.obs.workload import (fingerprint_sql,
+                                   introspection_execution)
 from tpu_olap.executor import EngineConfig, QueryRunner
 from tpu_olap.obs.trace import (Trace, current_query_id,
                                 in_nested_execution, nested_execution,
@@ -94,6 +97,12 @@ class Engine:
         # route it back through the statement executor so the inner
         # aggregate rides the device path (fallback._run_inner_stmt)
         self.catalog.device_runner = self._run_stmt
+        # sys.* virtual datasources (catalog.systables; ISSUE 11): the
+        # engine is observable through its own SQL — sys.tables /
+        # sys.segments / sys.queries / sys.query_templates / sys.metrics
+        # / sys.caches resolve through the catalog to live-state frames
+        # served on the interpreter path with accounting suppressed
+        self.catalog.sys_provider = SysTableProvider(self)
 
     # ------------------------------------------------------- registration
 
@@ -238,11 +247,28 @@ class Engine:
         if verb is not None:
             return verb(self), None
         from tpu_olap.planner.sqlparse import parse_sql
+        pre_stmt = None
+        if _SYS_HINT_RE.search(query):
+            # probable sys.* introspection statement: confirm against
+            # the parsed tree (a string literal mentioning "sys." must
+            # not hijack a user query) and serve it outside the trace —
+            # introspection appears nowhere in its own stats. A parse
+            # failure defers to the traced path so the error records
+            # like any other bad statement; a confirmed non-sys parse
+            # is reused below (no double parse).
+            try:
+                pre_stmt = parse_sql(query)
+            except Exception:
+                pre_stmt = None
+            if pre_stmt is not None \
+                    and stmt_uses_sys(pre_stmt, self.catalog):
+                return self._execute_sys_stmt(pre_stmt), None
         with self.tracer.trace("sql") as root:
             root.set(sql=query)
             try:
                 with root.span("parse"):
-                    stmt = parse_sql(query)
+                    stmt = pre_stmt if pre_stmt is not None \
+                        else parse_sql(query)
                 with root.span("plan") as sp:
                     plan = self.planner.plan_stmt(stmt, query)
                     sp.set(rewritten=plan.rewritten)
@@ -357,6 +383,15 @@ class Engine:
             m["fallback_reason"] = plan.fallback_reason
         if getattr(plan, "breaker_fallback", False):
             m["fallback_breaker"] = True
+        # workload attribution (obs.workload): fallback statements
+        # fingerprint from their literal-masked SQL text, so the
+        # interpreter path lands in sys.query_templates too
+        if self.runner.workload.enabled:
+            try:
+                m["_wl"] = fingerprint_sql(plan.sql or "", stmt,
+                                           m["datasource"])
+            except Exception:  # noqa: BLE001 — profiling never raises
+                pass
         t0 = time.perf_counter()
         with _span("fallback") as sp:
             sp.set(reason=plan.fallback_reason)
@@ -464,6 +499,13 @@ class Engine:
         single-query path (device retry, then pandas fallback), so the
         'never an error' property holds per statement. Results come
         back in input order."""
+        return self.sql_batch_ids(queries)[0]
+
+    def sql_batch_ids(self, queries):
+        """sql_batch plus each statement's query_id (parallel to the
+        results) — the ids the /sql/batch X-Query-Id header carries so
+        clients can correlate responses with /debug/queries,
+        sys.queries, and Perfetto traces."""
         queries = list(queries)
         outs: list = [None] * len(queries)
         plans: dict[int, object] = {}
@@ -476,8 +518,23 @@ class Engine:
             for i, q in enumerate(queries):
                 verb = _match_verb(q)
                 if verb is not None:
-                    outs[i] = verb(self)
+                    # statement verbs and sys.* introspection produce
+                    # no history record: "-" in the X-Query-Id slot
+                    # keeps the header positional without handing the
+                    # client an id that matches nothing
+                    outs[i], qids[i] = verb(self), "-"
                     continue
+                if _SYS_HINT_RE.search(q):
+                    from tpu_olap.planner.sqlparse import parse_sql
+                    try:
+                        stmt = parse_sql(q)
+                    except Exception:
+                        stmt = None  # the plan span raises it properly
+                    if stmt is not None \
+                            and stmt_uses_sys(stmt, self.catalog):
+                        outs[i] = self._execute_sys_stmt(stmt)
+                        qids[i] = "-"
+                        continue
                 with root.span("plan", query_id=qids[i]):
                     plan = self.planner.plan(q)
                 plans[i] = plan
@@ -543,7 +600,7 @@ class Engine:
                         raise
             if plans:
                 self.last_plan = plans[max(plans)]
-        return outs
+        return outs, qids
 
     def _run_stmt(self, stmt) -> pd.DataFrame:
         """Execute one parsed statement end-to-end (device path when
@@ -554,6 +611,31 @@ class Engine:
         statement's served response."""
         with nested_execution():
             return self._execute_plan(self.planner.plan_stmt(stmt))
+
+    def _execute_sys_stmt(self, stmt) -> pd.DataFrame:
+        """Serve a sys.* introspection statement (catalog.systables) on
+        the host/interpreter path: a sys datasource is never device
+        dispatch, never cached, and its execution is accounting-
+        suppressed — no trace, no history record, no metric/SLO
+        observation, no profiler template — so introspection can never
+        recurse into its own stats (ISSUE 11). The statement still gets
+        the planner's normalization passes, so aliases, windows over
+        groups, and expression simplification behave exactly like any
+        other fallback statement."""
+        from tpu_olap.obs.trace import detached_trace
+        from tpu_olap.planner.exprutil import simplify_stmt
+        from tpu_olap.planner.plan import _apply_windows_over_groups
+        from tpu_olap.planner.sqlparse import UnionStmt
+        # detached_trace: a sys statement inside a live trace (an
+        # sql_batch submission) must not leak its fallback spans into
+        # that trace's ring/Perfetto export
+        with introspection_execution(), nested_execution(), \
+                detached_trace():
+            stmt = self.planner._resolve_aliases(stmt)
+            stmt = _apply_windows_over_groups(stmt)
+            if not isinstance(stmt, UnionStmt):
+                stmt = simplify_stmt(stmt)
+            return execute_fallback(stmt, self.catalog, self.config)
 
     def _frame_from(self, plan, res: QueryResult) -> pd.DataFrame:
         # full-result cache hits carry their entry's live meta dict
@@ -718,6 +800,10 @@ _EXEC_RE = _re.compile(
 _SEARCH_RE = _re.compile(
     r"^\s*search\s+druid\s+datasource\s+(\w+)\s+for\s+'((?:[^']|'')*)'"
     r"(?:\s+in\s+([\w\s,]+?))?(?:\s+limit\s+(\d+))?\s*;?\s*$", _re.I)
+# cheap pre-parse hint that a statement MIGHT reference a sys.* virtual
+# datasource (catalog.systables): a match still confirms against the
+# parsed tree before taking the introspection path
+_SYS_HINT_RE = _re.compile(r"\bsys\.[A-Za-z_]\w*", _re.I)
 
 
 def _match_verb(query: str):

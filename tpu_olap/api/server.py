@@ -7,7 +7,10 @@ equivalent is a JSON-over-HTTP surface (stdlib only, no new deps):
 
   POST /sql          {"query": "SELECT ..."}      -> {columns, rows}
                      (statement verbs work too: CLEAR DRUID CACHE,
-                     EXPLAIN ANALYZE, ...)
+                     EXPLAIN ANALYZE, ...; the response carries an
+                     X-Query-Id header correlating it with
+                     /debug/queries, sys.queries, and Perfetto traces —
+                     /sql/batch returns a comma-separated id list)
   POST /druid/v2     native Druid query JSON      -> Druid-wire results
                      (the raw-IR passthrough, SURVEY.md §4.5 — lets
                      existing Druid clients talk to the TPU engine)
@@ -29,6 +32,11 @@ equivalent is a JSON-over-HTTP surface (stdlib only, no new deps):
   GET  /debug/cache  semantic result-cache state: per-tier entries/
                      bytes/hits/misses/evictions + per-table ingest
                      generations (docs/CACHING.md)
+  GET  /debug/workload  the query-template profiler (obs.workload):
+                     top templates with latency percentiles and cache
+                     hit-rates, plus ranked rollup-cube recommendations
+                     — the SQL spelling is SELECT ... FROM
+                     sys.query_templates (docs/OBSERVABILITY.md)
   POST /debug/profile?ms=N
                      on-demand jax.profiler capture for N ms (capped);
                      dispatches inside the window are annotated with
@@ -211,7 +219,9 @@ class QueryServer:
             def do_POST(self):
                 server._enter()
                 try:
-                    self._send(200, server._post(self.path, self._body()))
+                    payload, headers = server._post(self.path,
+                                                    self._body())
+                    self._send(200, payload, headers)
                 except QueryError as e:
                     # taxonomy first: UserError IS a ValueError and
                     # FallbackError maps to 400 through http_status, so
@@ -336,6 +346,21 @@ class QueryServer:
             n = _int_param(_parse_query(path), ("n", "limit"),
                            cap=self.engine.tracer.ring_limit)
             return chrome_trace(self.engine.tracer.recent_traces(n))
+        if path == "/debug/workload" or path.startswith("/debug/workload?"):
+            # the workload profiler (obs.workload; ISSUE 11): top query
+            # templates by count plus the cube advisor's ranked rollup
+            # recommendations — the same signal as SELECT ... FROM
+            # sys.query_templates, without going through SQL. ?n= bounds
+            # the template rows (default 20); recommendations always
+            # rank over the full template set.
+            from tpu_olap.obs.workload import recommend_rollups
+            prof = self.engine.runner.workload
+            n = _int_param(_parse_query(path), ("n", "limit"),
+                           default=20)
+            rows = prof.snapshot()
+            return {"totals": prof.totals(),
+                    "templates": rows[:n] if n else rows,
+                    "recommendations": recommend_rollups(rows)}
         if path == "/debug/cache" or path.startswith("/debug/cache?"):
             # semantic result-cache state (executor.resultcache;
             # docs/CACHING.md): per-tier entries/bytes/hit counters plus
@@ -371,23 +396,30 @@ class QueryServer:
         return m.render()
 
     def _post(self, path: str, body: str):
+        """(payload, headers) for a POST. /sql and /sql/batch answer
+        with an X-Query-Id header (ISSUE 11 satellite) so a client can
+        correlate a response with /debug/queries, SELECT ... FROM
+        sys.queries, and Perfetto traces."""
         if path == "/sql":
             req = json.loads(body)
-            frame = self.engine.sql(req["query"])
+            frame, trace = self.engine._sql_traced(req["query"])
+            headers = [("X-Query-Id", trace.query_id)] \
+                if trace is not None else []
             return {"columns": list(frame.columns),
-                    "rows": frame.to_dict("records")}
+                    "rows": frame.to_dict("records")}, headers
         if path == "/sql/batch":
             # explicit batch submission: one POST, N statements, shared
             # scans where compatible (Engine.sql_batch / executor.batch)
             req = json.loads(body)
-            frames = self.engine.sql_batch(req["queries"])
+            frames, qids = self.engine.sql_batch_ids(req["queries"])
             return {"results": [{"columns": list(f.columns),
                                  "rows": f.to_dict("records")}
-                                for f in frames]}
+                                for f in frames]}, \
+                [("X-Query-Id", ",".join(qids))]
         if path in ("/druid/v2", "/druid/v2/"):
             spec = json.loads(body)
             res = self.engine.execute_ir(spec)
-            return res.druid
+            return res.druid, []
         if path == "/debug/profile" or path.startswith("/debug/profile?"):
             # on-demand device capture: blocks THIS handler thread for
             # the window while other threads keep serving (their
@@ -397,5 +429,5 @@ class QueryServer:
             ms = _int_param(_parse_query(path), ("ms",),
                             cap=profile_mod.CAPTURE_MS_MAX,
                             default=profile_mod.CAPTURE_MS_DEFAULT)
-            return profile_mod.capture_device_profile(ms)
+            return profile_mod.capture_device_profile(ms), []
         raise KeyError(f"unknown path {path!r}")

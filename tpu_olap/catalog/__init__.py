@@ -7,3 +7,5 @@ with explicit invalidation (DruidMetadataCache + CLEAR DRUID CACHE).
 
 from tpu_olap.catalog.star import StarSchema, StarDimension, FunctionalDependency  # noqa: F401
 from tpu_olap.catalog.catalog import Catalog, TableEntry  # noqa: F401
+from tpu_olap.catalog.systables import (SysTableProvider,  # noqa: F401
+                                        stmt_uses_sys)

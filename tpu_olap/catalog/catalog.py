@@ -155,17 +155,34 @@ class Catalog:
         # registered lookup maps (Druid's lookup extraction fns): the
         # SQL spelling LOOKUP(col, 'name') resolves through this
         self.lookups: dict[str, dict] = {}
+        # `sys.*` virtual datasources (catalog.systables): the engine
+        # wires a SysTableProvider; get()/maybe() resolve unregistered
+        # sys names through it to fresh live-state entries. A REGISTERED
+        # table always shadows a sys name.
+        self.sys_provider = None
 
     def register(self, entry: TableEntry):
         self._tables[entry.name] = entry
 
+    def is_sys(self, name) -> bool:
+        """True when `name` resolves to a sys.* virtual datasource (not
+        shadowed by a registered table)."""
+        return (name is not None and name not in self._tables
+                and self.sys_provider is not None
+                and self.sys_provider.has(name))
+
     def get(self, name: str) -> TableEntry:
         if name not in self._tables:
+            if self.is_sys(name):
+                return self.sys_provider.entry(name)
             raise KeyError(f"unknown table {name!r}")
         return self._tables[name]
 
     def maybe(self, name: str) -> TableEntry | None:
-        return self._tables.get(name)
+        e = self._tables.get(name)
+        if e is None and self.is_sys(name):
+            return self.sys_provider.entry(name)
+        return e
 
     def names(self):
         return sorted(self._tables)

@@ -1,0 +1,235 @@
+"""`sys.*` virtual datasources — the engine observable through its own
+SQL (ISSUE 11 tentpole, the Druid `sys` schema analog).
+
+A `sys.<name>` reference resolves through the catalog to a fresh
+TableEntry whose frame builds from LIVE engine state at access time —
+never ingested, never accelerated, never cached. The engine routes any
+statement touching a sys datasource onto the host/interpreter path
+inside `introspection_execution()` (obs.workload), so introspection
+queries are served by the ordinary SQL machinery (filters, aggregates,
+ORDER BY/LIMIT, joins — even against user tables) while appearing
+nowhere in their own stats: no history record, no metrics, no SLO
+observation, no profiler template, no cache entry.
+
+Datasources (column tables in docs/OBSERVABILITY.md):
+
+  sys.tables           registered datasources + size/generation
+  sys.segments         per-segment rows/interval/generation/bytes +
+                       whether the tier-1 cache pins partials for it
+  sys.queries          the per-query history ring (QueryRunner.history)
+  sys.query_templates  the workload profiler (obs.workload) — count,
+                       latency percentiles, cache hit-rate, dims, grains
+  sys.metrics          the metrics registry, one row per series
+  sys.caches           result-cache tiers + runner cache populations
+"""
+
+from __future__ import annotations
+
+import pandas as pd
+
+SYS_PREFIX = "sys."
+
+__all__ = ["SYS_PREFIX", "SysTableProvider", "stmt_uses_sys"]
+
+
+def _expr_uses_sys(e, catalog) -> bool:
+    """Expression-level subqueries (WHERE x IN (SELECT ... FROM
+    sys.queries), EXISTS, scalar) reference sys datasources too — they
+    must route the WHOLE statement onto the suppressed introspection
+    path, or the inner sys scan would execute unsuppressed."""
+    from tpu_olap.ir.expr import BinOp, FuncCall, Subquery, WindowCall
+    if isinstance(e, Subquery):
+        return stmt_uses_sys(e.stmt, catalog)
+    if isinstance(e, BinOp):
+        return _expr_uses_sys(e.left, catalog) \
+            or _expr_uses_sys(e.right, catalog)
+    if isinstance(e, FuncCall):
+        return any(_expr_uses_sys(a, catalog) for a in e.args)
+    if isinstance(e, WindowCall):
+        return any(_expr_uses_sys(a, catalog) for a in e.args) \
+            or any(_expr_uses_sys(p, catalog) for p in e.partition_by) \
+            or any(_expr_uses_sys(oe, catalog)
+                   for oe, _ in e.order_by)
+    return False
+
+
+def stmt_uses_sys(stmt, catalog) -> bool:
+    """True when any datasource reference in the statement tree —
+    FROM/JOIN position, derived tables, or expression subqueries —
+    resolves to a sys datasource (a REGISTERED table shadowing a sys
+    name stays a user table)."""
+    from tpu_olap.planner.sqlparse import UnionStmt
+    if stmt is None:
+        return False
+    if isinstance(stmt, UnionStmt):
+        return any(stmt_uses_sys(p, catalog) for p in stmt.parts)
+    if catalog.is_sys(getattr(stmt, "table", None)):
+        return True
+    if stmt_uses_sys(getattr(stmt, "derived", None), catalog):
+        return True
+    for j in getattr(stmt, "joins", ()):
+        if catalog.is_sys(j.table) or \
+                stmt_uses_sys(getattr(j, "derived", None), catalog):
+            return True
+        if j.on is not None and _expr_uses_sys(j.on, catalog):
+            return True
+    exprs = [e for e, _ in getattr(stmt, "projections", ())]
+    exprs += list(getattr(stmt, "group_by", ()) or ())
+    exprs.append(getattr(stmt, "where", None))
+    exprs.append(getattr(stmt, "having", None))
+    exprs += [o.expr for o in getattr(stmt, "order_by", ()) or ()]
+    return any(e is not None and _expr_uses_sys(e, catalog)
+               for e in exprs)
+
+
+# ------------------------------------------------------- frame builders
+
+def _tables_frame(engine) -> pd.DataFrame:
+    dev = engine.runner.device_bytes_by_table()
+    rows = []
+    for name in engine.catalog.names():
+        e = engine.catalog.get(name)
+        acc = e.is_accelerated
+        rows.append({
+            "table": name,
+            "accelerated": acc,
+            # null until the lazy fallback frame materializes — listing
+            # tables must not force a parquet load (same rule as /status)
+            "rows": (e.segments.num_rows if acc else e.materialized_rows),
+            "segments": len(e.segments.segments) if acc else 0,
+            "generation": e.segments.generation if acc else None,
+            "time_column": e.time_column,
+            "device_bytes": dev.get(name, 0),
+        })
+    return pd.DataFrame(rows, columns=[
+        "table", "accelerated", "rows", "segments", "generation",
+        "time_column", "device_bytes"])
+
+
+def _segments_frame(engine) -> pd.DataFrame:
+    pinned = engine.runner.result_cache.cached_segments()
+    rows = []
+    for name in engine.catalog.names():
+        e = engine.catalog.get(name)
+        if not e.is_accelerated:
+            continue
+        ts = e.segments
+        for s in ts.segments:
+            nbytes = sum(int(a.nbytes) for a in s.columns.values()) \
+                + sum(int(a.nbytes) for a in s.null_masks.values())
+            rows.append({
+                "table": name,
+                "segment_id": s.meta.segment_id,
+                "rows": s.meta.n_valid,
+                "time_min": s.meta.time_min,
+                "time_max": s.meta.time_max,
+                "generation": ts.generation,
+                "bytes": nbytes,
+                "cache_pinned": (name, s.meta.segment_id) in pinned,
+            })
+    return pd.DataFrame(rows, columns=[
+        "table", "segment_id", "rows", "time_min", "time_max",
+        "generation", "bytes", "cache_pinned"])
+
+
+_QUERY_COLS = (
+    "query_id", "ts_ms", "query_type", "datasource", "path",
+    "template_id", "total_ms", "rows_scanned", "segments_scanned",
+    "rows_returned", "cache_hit", "cache_tier", "failed", "pipelined",
+    "batch_id", "fallback_reason")
+
+
+def _queries_frame(engine) -> pd.DataFrame:
+    recs = list(engine.runner.history)
+    rows = []
+    for r in recs:
+        if r.get("query_type", "?") == "?":
+            continue  # runner notes (healer/reprobe), not queries
+        row = {c: r.get(c) for c in _QUERY_COLS}
+        row["cache_hit"] = bool(r.get("cache_hit"))
+        row["failed"] = bool(r.get("failed"))
+        rows.append(row)
+    return pd.DataFrame(rows, columns=list(_QUERY_COLS))
+
+
+_TEMPLATE_COLS = (
+    "template_id", "datasource", "query_type", "count", "failures",
+    "p50_ms", "p95_ms", "p99_ms", "mean_ms", "total_ms", "rows_scanned",
+    "segments_scanned", "cache_hit_rate", "cache_full_hits",
+    "cache_segment_hits", "segments_cached", "dims", "granularities",
+    "paths", "first_seen_ms", "last_seen_ms", "template")
+
+
+def _templates_frame(engine) -> pd.DataFrame:
+    return pd.DataFrame(engine.runner.workload.snapshot(),
+                        columns=list(_TEMPLATE_COLS))
+
+
+def _metrics_frame(engine) -> pd.DataFrame:
+    engine.runner.refresh_resource_gauges()
+    return pd.DataFrame(engine.metrics.snapshot_rows(), columns=[
+        "name", "kind", "labels", "value", "count", "total"])
+
+
+def _caches_frame(engine) -> pd.DataFrame:
+    runner = engine.runner
+    snap = runner.result_cache.snapshot()
+    rows = []
+    for tier in ("full", "segment"):
+        t = snap[tier]
+        rows.append({
+            "cache": tier, "entries": t["entries"], "bytes": t["bytes"],
+            "budget_bytes": t["budget_bytes"], "hit": t["hit"],
+            "miss": t["miss"], "bypass": t["bypass"],
+            "evict": t["evict"], "enabled": snap["enabled"][tier]})
+    for cname, store in (("jit", runner._jit_cache),
+                         ("plan", runner._plan_cache),
+                         ("arg", runner._arg_cache)):
+        rows.append({"cache": cname, "entries": len(store), "bytes": None,
+                     "budget_bytes": None, "hit": None, "miss": None,
+                     "bypass": None, "evict": None, "enabled": True})
+    return pd.DataFrame(rows, columns=[
+        "cache", "entries", "bytes", "budget_bytes", "hit", "miss",
+        "bypass", "evict", "enabled"])
+
+
+class SysTableProvider:
+    """Resolves `sys.<name>` catalog lookups to lazily-built TableEntry
+    objects over live engine state. Inside an introspection statement
+    (obs.workload.introspection_scope) resolutions memoize per name, so
+    however many times planning + execution consult the catalog — alias
+    resolution, the scan, both sides of a self-join — the statement
+    sees ONE point-in-time snapshot per sys table. Outside that scope
+    each resolution is a fresh entry (never staler than its caller)."""
+
+    _BUILDERS = {
+        "sys.tables": _tables_frame,
+        "sys.segments": _segments_frame,
+        "sys.queries": _queries_frame,
+        "sys.query_templates": _templates_frame,
+        "sys.metrics": _metrics_frame,
+        "sys.caches": _caches_frame,
+    }
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def has(self, name) -> bool:
+        return name in self._BUILDERS
+
+    def names(self):
+        return sorted(self._BUILDERS)
+
+    def entry(self, name):
+        from tpu_olap.catalog.catalog import TableEntry
+        from tpu_olap.obs.workload import introspection_scope
+        scope = introspection_scope()
+        if scope is not None and name in scope:
+            return scope[name]
+        build = self._BUILDERS[name]
+        eng = self.engine
+        entry = TableEntry(name=name, segments=None,
+                           frame_source=lambda: build(eng))
+        if scope is not None:
+            scope[name] = entry
+        return entry

@@ -253,9 +253,15 @@ def _run_fused(runner, table, group, query_ids=None):
     n_logical = sum(len(idxs) for _, idxs, _ in group)
     batch_id = runner._next_batch_id()
     runner._m_batch.observe(n_logical)
+    # per-leg workload fingerprints (obs.workload): fused legs are real
+    # logical queries and must attribute to their own templates — the
+    # `_wl` key is consumed by record(), so keep a parallel list for
+    # the full-cache store below
+    leg_fps = [runner.fingerprint(q, table.name) for q, _, _ in group]
     metrics_list = [{"query_type": q.query_type, "datasource": table.name,
                      "batch_id": batch_id, "batch_size": n_logical,
-                     "batch_legs": len(group)} for q, _, _ in group]
+                     "batch_legs": len(group), "_wl": fp}
+                    for (q, _, _), fp in zip(group, leg_fps)]
     if query_ids is not None:
         for (_, idxs, _), m in zip(group, metrics_list):
             if query_ids[idxs[0]]:
@@ -351,7 +357,7 @@ def _run_fused(runner, table, group, query_ids=None):
             runner.record(m)
             # fused legs populate the same full-result tier the
             # single-query path serves from (docs/CACHING.md)
-            runner._store_full_cache(q, table, res)
+            runner._store_full_cache(q, table, res, leg_fps[leg_i])
             lsp.set(query_id=m["query_id"], query_type=m["query_type"],
                     agg_ms=round(leg_ms, 3), duplicates=len(idxs))
             results.append(res)

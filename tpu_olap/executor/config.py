@@ -279,6 +279,15 @@ class EngineConfig:
     slo_latency_ms: float = 500.0
     slo_target: float = 0.99
     slo_window_s: float = 3600.0
+    # workload profiler (obs.workload; ISSUE 11): every completed-query
+    # record folds into bounded per-template rolling stats — the demand
+    # signal behind sys.query_templates, GET /debug/workload, and the
+    # cube advisor. workload_max_templates bounds distinct templates
+    # (least-recently-seen evicts); workload_latency_window bounds the
+    # per-template latency ring the p50/p95/p99 derive from.
+    workload_profile_enabled: bool = True
+    workload_max_templates: int = 512
+    workload_latency_window: int = 512
 
     # Pallas fused one-hot MXU reduce (kernels.pallas_reduce): "auto" uses
     # it on the TPU backend for eligible plans, "force" uses it everywhere

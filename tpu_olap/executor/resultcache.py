@@ -407,6 +407,14 @@ class ResultCache:
 
     # -------------------------------------------------------------- admin
 
+    def cached_segments(self) -> set:
+        """{(table, segment_id)} pairs with at least one live tier-1
+        partial entry — the `cache_pinned` column of sys.segments
+        (catalog.systables). Key layout: (tkey, generation, sid) with
+        tkey leading with the table name."""
+        with self._lock:
+            return {(k[0][0], k[2]) for k in self._seg}
+
     def count_bypass(self, tier: str = "segment"):
         self._count(tier, "bypass")
 

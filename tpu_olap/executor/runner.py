@@ -27,6 +27,8 @@ from tpu_olap.obs.slo import SloTracker
 from tpu_olap.obs.trace import (Tracer, current_query_id,
                                 in_nested_execution, short_str,
                                 span as _span)
+from tpu_olap.obs.workload import (WorkloadProfiler, fingerprint_ir,
+                                   in_introspection)
 from tpu_olap.resilience.admission import AdmissionController
 from tpu_olap.resilience.breaker import CircuitBreaker
 from tpu_olap.resilience.errors import QueryError
@@ -310,6 +312,13 @@ class QueryRunner:
         from tpu_olap.executor.resultcache import ResultCache
         self.result_cache = ResultCache(self.config, metrics=m,
                                         events=self.events)
+        # workload profiler (obs.workload; ISSUE 11): record() folds
+        # every completed-query record into per-template rolling stats —
+        # the sys.query_templates / cube-advisor demand signal
+        self.workload = WorkloadProfiler(
+            max_templates=self.config.workload_max_templates,
+            latency_window=self.config.workload_latency_window,
+            enabled=self.config.workload_profile_enabled, metrics=m)
         self._attempt_local = threading.local()  # host-transfer inject
 
     def _inject(self, stage: str):
@@ -469,11 +478,23 @@ class QueryRunner:
         append to the bounded history ring. Sanitization is IN PLACE so
         a QueryResult.metrics dict sharing this object stays the
         consistent view."""
+        # the transient fingerprint rides under `_wl` (obs.workload):
+        # popped before sanitization so the object never stringifies
+        fp = m.pop("_wl", None)
         had_jit_key = "jit_cache_hit" in m
         for k in list(m):
             m[k] = sanitize_metric_value(m[k])
+        if in_introspection():
+            # sys.* introspection statements leave NO trace of
+            # themselves: no history record, no metrics/SLO, no event,
+            # no profiler observation — a query over sys.queries can
+            # never recurse into its own stats (ISSUE 11)
+            return m
         m.setdefault("query_id",
                      current_query_id() or self.tracer.new_query_id())
+        m.setdefault("ts_ms", int(time.time() * 1000))
+        if fp is not None:
+            m.setdefault("template_id", fp.template_id)
         for k, v in CORE_METRIC_DEFAULTS:
             m.setdefault(k, v)
         qt, path = m["query_type"], self._metric_path(m)
@@ -491,6 +512,10 @@ class QueryRunner:
                    if k.startswith("device_probe")})
             self.history.append(m)
             return m
+        # workload attribution (obs.workload): every real query record —
+        # device, fallback, cache hit, batch leg, dedup fan-out, nested
+        # leg — folds into its template's rolling stats
+        self.workload.observe(m, fp)
         with self._totals_lock:
             t = self._totals
             t["queries"] += 1
@@ -1009,16 +1034,30 @@ class QueryRunner:
             m["query_type"] = query.query_type
             m["datasource"] = table.name
             m["total_ms"] = (time.perf_counter() - t0) * 1000
+            m["_wl"] = self.fingerprint(query, table.name)
             if abandoned is None or not abandoned.is_set():
                 self.record(m)
             raise
         res.metrics["total_ms"] = (time.perf_counter() - t0) * 1000
         res.metrics["query_type"] = query.query_type
         res.metrics["datasource"] = table.name
+        fp = self.fingerprint(query, table.name)
+        res.metrics["_wl"] = fp
         if abandoned is None or not abandoned.is_set():
             self.record(res.metrics)
-            self._store_full_cache(query, table, res)
+            self._store_full_cache(query, table, res, fp)
         return res
+
+    def fingerprint(self, query, table_name: str):
+        """Workload template of a device-path query spec (obs.workload)
+        — None (profiling off / exotic spec) just skips attribution,
+        never fails the query."""
+        if not self.workload.enabled:
+            return None
+        try:
+            return fingerprint_ir(query, table_name)
+        except Exception:  # noqa: BLE001 — profiling must never raise
+            return None
 
     # --------------------------------------------- semantic result cache
 
@@ -1030,7 +1069,7 @@ class QueryRunner:
         record (cache_hit=True, cache_tier="full", path="cache",
         rows_scanned=0). None = miss/bypass, caller executes."""
         rc = self.result_cache
-        if not rc.full_enabled \
+        if not rc.full_enabled or in_introspection() \
                 or getattr(query, "query_type", None) \
                 not in self._CACHEABLE_QUERY_TYPES \
                 or getattr(table, "generation", None) is None:
@@ -1047,6 +1086,9 @@ class QueryRunner:
              "rows_scanned": 0, "segments_scanned": 0,
              "segments_total": meta.get("segments_total", 0),
              "rows_returned": len(rows),
+             # the fingerprint is memoized on the entry's meta at store
+             # time: warm serves must not pay the normalization walk
+             "_wl": meta.get("_wl_fp"),
              "total_ms": (time.perf_counter() - t0) * 1000}
         res = QueryResult(query, rows, druid, m)
         # the entry's live meta dict rides along so the SQL layer can
@@ -1057,18 +1099,22 @@ class QueryRunner:
         self.record(m)
         return res
 
-    def _store_full_cache(self, query, table, res: QueryResult):
+    def _store_full_cache(self, query, table, res: QueryResult,
+                          fp=None):
         """Populate tier 2 from a successfully served result (single
-        path, batch singles, and fused batch legs all funnel here)."""
+        path, batch singles, and fused batch legs all funnel here).
+        `fp` is the query's workload fingerprint, memoized on the entry
+        meta so warm serves re-stamp it without re-normalizing."""
         rc = self.result_cache
-        if not rc.full_enabled \
+        if not rc.full_enabled or in_introspection() \
                 or getattr(query, "query_type", None) \
                 not in self._CACHEABLE_QUERY_TYPES \
                 or getattr(table, "generation", None) is None \
                 or res.metrics.get("failed"):
             return
         rc.put_full(query, table, res.rows, res.druid, {
-            "segments_total": res.metrics.get("segments_total", 0)})
+            "segments_total": res.metrics.get("segments_total", 0),
+            "_wl_fp": fp})
 
     def _lower_cached(self, query, table):
         """Memoized lower(): re-lowering an unchanged query template
@@ -1873,6 +1919,12 @@ class QueryRunner:
         from tpu_olap.kernels.groupby import merge_partials
 
         rc = self.result_cache
+        if in_introspection():
+            # sys.* introspection must not consult, populate, or tick
+            # counters on EITHER cache tier (same rule as
+            # _serve_full_cache): observing the system cannot change
+            # sys.caches / cache_pinned / result_cache_* metrics
+            return None
         reason = rc.tier1_bypass_reason(plan, self.mesh)
         if reason is not None:
             metrics["segment_cache"] = f"bypass: {reason}"

@@ -8,9 +8,11 @@ assemble, with batch legs nested under their shared-scan span), `metrics`
 maintains incrementally-updated counters/gauges/histograms rendered in
 Prometheus text exposition format, `profile` exports span trees as
 Chrome-trace/Perfetto timelines and wraps on-demand jax.profiler
-captures, `events` is the structured JSON-lines event log, and `slo`
-tracks latency objectives with a burn-rate gauge. No new dependencies —
-monotonic clocks, contextvars propagation, stdlib formatting only.
+captures, `events` is the structured JSON-lines event log, `slo`
+tracks latency objectives with a burn-rate gauge, and `workload` is the
+query-template profiler behind `sys.query_templates` and the cube
+advisor's demand signal (ISSUE 11). No new dependencies — monotonic
+clocks, contextvars propagation, stdlib formatting only.
 """
 
 from tpu_olap.obs.events import EventLog  # noqa: F401
@@ -22,3 +24,8 @@ from tpu_olap.obs.slo import SloTracker  # noqa: F401
 from tpu_olap.obs.trace import (NULL_SPAN, Span, Trace,  # noqa: F401
                                 Tracer, current_query_id, current_span,
                                 span)
+from tpu_olap.obs.workload import (Fingerprint,  # noqa: F401
+                                   WorkloadProfiler, fingerprint_ir,
+                                   fingerprint_sql, in_introspection,
+                                   introspection_execution,
+                                   recommend_rollups)

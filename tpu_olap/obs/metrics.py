@@ -25,9 +25,13 @@ import threading
 
 # fixed latency buckets (ms): sub-ms through minutes, pow-ish spacing so
 # p50/p95/p99 are derivable by interpolation at every scale the engine
-# serves (µs-cache-hit CPU runs through multi-second fallbacks)
-LATENCY_BUCKETS_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
-                      500.0, 1000.0, 2500.0, 5000.0, 10000.0, 30000.0)
+# serves. The 0.1/0.25/0.5 head exists for the warm-cache path: a
+# full-result-cache serve is ~0.6 ms (BENCH_CACHE.json), and with 1.0 as
+# the first bound every warm hit collapsed into one bucket, making
+# cache-path p50 and p95 indistinguishable (ISSUE 11 satellite).
+LATENCY_BUCKETS_MS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+                      100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0,
+                      10000.0, 30000.0)
 
 # admission queue-wait buckets (ms): most admitted queries wait 0 or a
 # few ms; the tail matters up to roughly one deadline (past that the
@@ -220,6 +224,30 @@ class MetricsRegistry:
                   buckets=LATENCY_BUCKETS_MS) -> Histogram:
         return self._get_or_make(Histogram, name, help, labelnames,
                                  buckets=buckets)
+
+    def snapshot_rows(self) -> list:
+        """One dict per live series — the tabular registry view behind
+        `sys.metrics` (catalog.systables): scalar metrics carry `value`,
+        histogram series carry observation `count` and `total` (the
+        _count/_sum pair; per-bucket counts stay on /metrics)."""
+        import json
+        with self._lock:
+            rows = []
+            for m in sorted(self._metrics.values(),
+                            key=lambda m: m.name):
+                for key in sorted(m.series):
+                    s = m.series[key]
+                    labels = json.dumps(dict(zip(m.labelnames, key)),
+                                        sort_keys=True)
+                    if isinstance(m, Histogram):
+                        rows.append({"name": m.name, "kind": m.kind,
+                                     "labels": labels, "value": None,
+                                     "count": s.n, "total": s.total})
+                    else:
+                        rows.append({"name": m.name, "kind": m.kind,
+                                     "labels": labels, "value": s.value,
+                                     "count": None, "total": None})
+        return rows
 
     # ------------------------------------------------------------ render
 

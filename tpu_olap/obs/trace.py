@@ -225,6 +225,27 @@ def in_nested_execution() -> bool:
     return _nested_exec.get()
 
 
+class detached_trace:
+    """Detach the span/query-id context for a scope: instrumented code
+    inside sees no active trace, so its spans are NULL_SPAN no-ops.
+    Used by sys.* introspection statements running INSIDE another
+    live trace (a /sql/batch submission) — their fallback spans must
+    not leak into the submitting trace's ring/Perfetto export
+    (introspection appears nowhere in its own stats, ISSUE 11)."""
+
+    __slots__ = ("_t_span", "_t_qid")
+
+    def __enter__(self):
+        self._t_span = _current_span.set(None)
+        self._t_qid = _current_qid.set(None)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        _current_span.reset(self._t_span)
+        _current_qid.reset(self._t_qid)
+        return False
+
+
 class use_query_id:
     """Override the propagated query_id for a scope WITHOUT re-rooting
     the span tree — Engine.sql_batch runs each non-fused statement
