@@ -756,6 +756,157 @@ def _cache_main(mode: str) -> int:
     return 0 if parity_ok else 1
 
 
+def _cube_main(mode: str) -> int:
+    """`bench.py --cube-mode off|auto`: the materialized-rollup A/B
+    (docs/CUBES.md). BASE is the honest floor — the rewrite pass
+    disabled and both semantic-cache tiers off, so every timed run is a
+    real base-table execution. AUTO then closes the advisor loop on the
+    bench's own traffic: the warm-up runs populate the workload
+    profiler, `cube_specs_from_workload` turns its ranked rollup
+    recommendations into specs, the materializer builds them, and the
+    same 13 SSB queries re-run — queries the rewrite covers serve from
+    cube partials (path="cube"). Banks BENCH_CUBES.json with per-query
+    base-vs-cube p50, materialization cost + storage bytes, coverage,
+    and parity: sha256 result digests must MATCH the base path exactly
+    for the all-integer SSB aggregates, and every covered query is
+    additionally checked against the independent pandas fallback."""
+    import hashlib
+
+    from tpu_olap.bench import QUERIES
+    from tpu_olap.bench.parity import ParityError, check_query
+
+    eng, ctx = _setup({"cube_auto_refresh": False})
+    note = ctx["note"]
+    iters = ctx["iters"]
+    qnames = sorted(QUERIES)
+    eng.config.cube_rewrite_enabled = False
+
+    def digest(frame) -> str:
+        return hashlib.sha256(
+            frame.to_csv(float_format="%.6g").encode()).hexdigest()[:16]
+
+    # warm compiles AND the workload profiler (the advisor's demand
+    # signal is the bench's own traffic — the loop the ISSUE closes)
+    for qn in qnames:
+        eng.sql(QUERIES[qn])
+        eng.sql(QUERIES[qn])
+        assert eng.last_plan.rewritten, (qn, eng.last_plan.fallback_reason)
+
+    def timed(qn, n):
+        times = []
+        cube_serves = 0
+        for _ in range(n):
+            n0 = len(eng.history)
+            t0 = time.perf_counter()
+            eng.sql(QUERIES[qn])
+            times.append((time.perf_counter() - t0) * 1000)
+            cube_serves += sum(1 for m in eng.history[n0:]
+                               if m.get("path") == "cube")
+        return times, cube_serves
+
+    base, base_digest = {}, {}
+    for qn in qnames:
+        times, _ = timed(qn, iters)
+        base[qn] = round(float(np.percentile(times, 50)), 3)
+        base_digest[qn] = digest(eng.sql(QUERIES[qn]))
+        note(f"{qn} base p50={base[qn]}ms")
+
+    out = {
+        "metric": "ssb_cube_base_p50_max_ms",
+        "value": round(max(base.values()), 3),
+        "unit": "ms",
+        "vs_baseline": round(TARGET_MS / max(base.values()), 2),
+        "detail": {
+            "mode": mode, "rows": ctx["rows"], "iters": iters,
+            "backend": ctx["backend"],
+            **({"tpu_unavailable": ctx["tpu_unavailable"]}
+               if ctx["tpu_unavailable"] else {}),
+            "per_query_base_p50_ms": base,
+        },
+    }
+    if mode == "auto":
+        from tpu_olap.cubes import cube_specs_from_workload
+        rows = eng.runner.workload.snapshot()
+        specs, notes = cube_specs_from_workload(rows, eng,
+                                                top=len(qnames))
+        t0 = time.perf_counter()
+        built, build_errors = [], {}
+        for s in specs:
+            try:
+                e = eng.create_cube(s)
+                built.append(s.name)
+                note(f"built {s.name}: {e.data.n_rows} rows @ "
+                     f"{s.granularity} in {e.build_ms:.0f}ms")
+            except Exception as ex:  # noqa: BLE001 — per-spec isolation
+                build_errors[s.name] = f"{type(ex).__name__}: {ex}"
+                note(f"build FAILED {s.name}: {build_errors[s.name]}")
+        build_s = time.perf_counter() - t0
+
+        eng.config.cube_rewrite_enabled = True
+        # the independent pandas-fallback oracle is O(full scan) per
+        # query — affordable at SF1, hours at SF10+. Digest equality
+        # against the base device path is checked at EVERY scale.
+        deep_parity = ctx["rows"] <= 10_000_000
+        cube_ms, covered, digest_ok, parity_errors = {}, [], {}, []
+        speedup = {}
+        for qn in qnames:
+            eng.sql(QUERIES[qn])  # settle (fold layout warm)
+            times, serves = timed(qn, iters)
+            cube_ms[qn] = round(float(np.percentile(times, 50)), 3)
+            is_covered = serves == iters
+            digest_ok[qn] = digest(eng.sql(QUERIES[qn])) \
+                == base_digest[qn]
+            if is_covered:
+                covered.append(qn)
+                speedup[qn] = round(base[qn] / max(cube_ms[qn], 1e-3),
+                                    2)
+                if deep_parity:
+                    try:
+                        check_query(eng, QUERIES[qn],
+                                    label=f"cube:{qn}")
+                    except ParityError as e:
+                        parity_errors.append(str(e)[:300])
+            note(f"{qn} cube p50={cube_ms[qn]}ms covered={is_covered} "
+                 f"digest_ok={digest_ok[qn]}"
+                 + (f" speedup={speedup.get(qn)}x" if is_covered
+                    else ""))
+        parity_ok = all(digest_ok.values()) and not parity_errors \
+            and bool(covered)
+        worst_speedup = min(speedup.values()) if speedup else 0.0
+        snap = eng.cubes.snapshot()  # after serving: serve_count live
+        storage = sum(r["storage_bytes"] + r["sketch_bytes"]
+                      for r in snap if r["status"] == "ready")
+        out["metric"] = "ssb_cube_covered_speedup_min"
+        out["value"] = worst_speedup
+        out["unit"] = "x"
+        out["vs_baseline"] = round(worst_speedup / 10.0, 2)  # >=10x
+        out["detail"].update({
+            "deep_parity_vs_fallback": deep_parity,
+            "advisor_specs": len(specs),
+            "advisor_notes": notes,
+            "cubes_built": built,
+            **({"build_errors": build_errors} if build_errors else {}),
+            "materialize_s": round(build_s, 2),
+            "cube_storage_bytes": storage,
+            "cubes": snap,
+            "per_query_cube_p50_ms": cube_ms,
+            "per_query_speedup": speedup,
+            "covered_queries": covered,
+            "uncovered_queries": [q for q in qnames
+                                  if q not in covered],
+            "digest_match": digest_ok,
+            "parity_ok": parity_ok,
+            **({"parity_errors": parity_errors[:5]}
+               if parity_errors else {}),
+        })
+    with open(os.path.join(REPO, "BENCH_CUBES.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    if mode != "auto":
+        return 0
+    return 0 if parity_ok else 1
+
+
 def _parse_args(argv=None):
     import argparse
     p = argparse.ArgumentParser(
@@ -778,6 +929,15 @@ def _parse_args(argv=None):
              "(repeats served from cache), mixed (cold + warm + a "
              "fresh-ingest invalidation phase with parity in every "
              "state); banks BENCH_CACHE.json (docs/CACHING.md)")
+    p.add_argument(
+        "--cube-mode", choices=("off", "auto"), default=None,
+        metavar="MODE",
+        help="run the materialized-rollup-cube bench instead of the "
+             "latency bench: off (base path only — the honest floor), "
+             "auto (advisor-recommended cubes materialized from the "
+             "bench's own workload profile, then base-vs-cube p50 with "
+             "parity digests, materialization cost, and storage "
+             "bytes); banks BENCH_CUBES.json (docs/CUBES.md)")
     p.add_argument(
         "--span-summary", action="store_true",
         help="emit per-query per-phase span timings (parse/plan/"
@@ -813,11 +973,19 @@ def _parse_args(argv=None):
                                         or args.inject_faults):
         p.error("--cache-mode is its own bench; it does not combine "
                 "with --concurrency/--trace-out/--inject-faults")
+    if args.cube_mode is not None and (args.concurrency is not None
+                                       or args.cache_mode is not None
+                                       or args.trace_out
+                                       or args.inject_faults):
+        p.error("--cube-mode is its own bench; it does not combine "
+                "with the other modes")
     return args
 
 
 if __name__ == "__main__":
     args = _parse_args()
+    if args.cube_mode is not None:
+        sys.exit(_cube_main(args.cube_mode))
     if args.cache_mode is not None:
         sys.exit(_cache_main(args.cache_mode))
     if args.concurrency is not None:

@@ -1,0 +1,512 @@
+"""Materialized rollup cubes (tpu_olap.cubes + planner.cuberewrite;
+docs/CUBES.md): build/rewrite parity across the aggregation matrix
+(SUM/COUNT/AVG/MIN/MAX/HLL/theta — exact match for exact aggs, exact
+sketch-state merge for the approximate ones), coarser-grain re-rollup
+from a finer cube, rewrite refusal cases (non-cube-dim filter,
+uncovered agg, straddling intervals, stale generation), the ingest
+invalidation contract (zero stale serves), DDL + sys.cubes +
+/debug/cubes, and the advisor -> materializer loop."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpu_olap import Engine
+from tpu_olap.cubes import CubeSpec, agg_signature, period_contains
+from tpu_olap.executor import EngineConfig
+
+N_ROWS = 40_000
+
+
+def _df(n=N_ROWS, seed=7, days=540):
+    rng = np.random.default_rng(seed)
+    v = rng.integers(0, 1000, n).astype(np.int64)
+    # a nullable measure: min/max/avg nullity must survive the rollup
+    w = rng.integers(0, 500, n).astype(np.float64)
+    w[rng.random(n) < 0.1] = np.nan
+    return pd.DataFrame({
+        "ts": pd.to_datetime("1997-01-01")
+        + pd.to_timedelta(np.sort(rng.integers(0, 86400 * days, n)),
+                          unit="s"),
+        "g": rng.choice([f"g{i}" for i in range(8)], n),
+        "r": rng.choice(["A", "B", "C"], n),
+        "y": (1997 + rng.integers(0, 2, n)).astype(np.int64),
+        "v": v,
+        "w": w,
+        "u": rng.integers(0, 5000, n).astype(np.int64),
+    })
+
+
+def _engine(df=None, **kw):
+    cfg = dict(cube_auto_refresh=False)
+    cfg.update(kw)
+    eng = Engine(EngineConfig(**cfg))
+    eng.register_table("t", df if df is not None else _df(),
+                       time_column="ts", block_rows=1 << 11,
+                       time_partition="month")
+    return eng
+
+
+FULL_DDL = ("CREATE DRUID CUBE c ON t DIMENSIONS (g, r, y) "
+            "GRANULARITY month AGGREGATES (sum(v), count(*), avg(v), "
+            "min(w), max(w), sum(w), approx_count_distinct(u), "
+            "theta_sketch(u), sum(v * 2))")
+
+
+def _cubed(df=None, **kw):
+    eng = _engine(df, **kw)
+    eng.sql(FULL_DDL)
+    return eng
+
+
+def _compare(eng, sql, expect_cube=True):
+    """Run once through the rewrite pass and once on the base device
+    path; assert identical frames and return the cube-run record."""
+    a = eng.sql(sql)
+    rec = dict(eng.history[-1])
+    if expect_cube:
+        assert rec.get("path") == "cube", (rec.get("path"), sql)
+    else:
+        assert rec.get("path") != "cube", sql
+    eng.config.cube_rewrite_enabled = False
+    try:
+        b = eng.sql(sql)
+        base = dict(eng.history[-1])
+        assert base.get("path") != "cube"
+    finally:
+        eng.config.cube_rewrite_enabled = True
+    pd.testing.assert_frame_equal(a, b)
+    return rec
+
+
+# ------------------------------------------------------ rewrite parity
+
+
+def test_agg_matrix_parity_groupby():
+    eng = _cubed()
+    rec = _compare(eng, (
+        "SELECT g, sum(v) AS s, count(*) AS n, avg(v) AS a, "
+        "min(w) AS mn, max(w) AS mx, sum(w) AS sw, "
+        "approx_count_distinct(u) AS d, theta_sketch(u) AS th "
+        "FROM t GROUP BY g ORDER BY g"))
+    assert rec["cube"] == "c"
+    assert rec["rows_scanned"] < N_ROWS  # cube rows, not base rows
+    assert rec["segments_scanned"] == 0
+
+
+def test_filters_on_cube_dims_and_extractions():
+    eng = _cubed()
+    _compare(eng, "SELECT g, sum(v) AS s FROM t WHERE r = 'A' "
+                  "GROUP BY g ORDER BY g")
+    _compare(eng, "SELECT g, sum(v) AS s FROM t WHERE r IN ('A', 'C') "
+                  "AND y = 1997 GROUP BY g ORDER BY g")
+    _compare(eng, "SELECT g, sum(v) AS s FROM t "
+                  "WHERE g LIKE 'g%' AND (r = 'A' OR r = 'B') "
+                  "GROUP BY g ORDER BY g")
+    # extraction over a cube dim: substr group + filter
+    _compare(eng, "SELECT substr(g, 1, 1) AS p, sum(v) AS s FROM t "
+                  "WHERE substr(r, 1, 1) = 'A' GROUP BY substr(g, 1, 1)"
+                  " ORDER BY p")
+
+
+def test_timeseries_topn_and_having():
+    eng = _cubed()
+    _compare(eng, "SELECT sum(v) AS s, count(*) AS n FROM t")
+    _compare(eng, "SELECT g, sum(v) AS s FROM t GROUP BY g "
+                  "ORDER BY s DESC LIMIT 3")  # topN shape
+    _compare(eng, "SELECT g, sum(v) AS s FROM t GROUP BY g "
+                  "HAVING sum(v) > 100000 ORDER BY g")
+
+
+def test_filtered_aggregate_signature_match_and_refusal():
+    """sum(CASE WHEN r='A' THEN v ELSE 0 END) lowers to a filtered
+    aggregation; the cube serves the EXACT same filtered form (the
+    filter literal is part of the stored signature) and refuses a
+    different literal."""
+    eng = _engine()
+    eng.sql("CREATE DRUID CUBE fc ON t DIMENSIONS (g) GRANULARITY all "
+            "AGGREGATES (sum(CASE WHEN r = 'A' THEN v ELSE 0 END), "
+            "count(v))")
+    _compare(eng, "SELECT g, sum(CASE WHEN r = 'A' THEN v ELSE 0 END) "
+                  "AS s, count(v) AS n FROM t GROUP BY g ORDER BY g")
+    _compare(eng, "SELECT g, sum(CASE WHEN r = 'B' THEN v ELSE 0 END) "
+                  "AS s FROM t GROUP BY g ORDER BY g",
+             expect_cube=False)
+
+
+def test_coarser_grain_re_rollup():
+    """A month-grain cube serves month, quarter, and year grains (and
+    the year(ts) timeformat dim) by re-bucketing stored partials."""
+    eng = _cubed()
+    for unit in ("month", "quarter", "year"):
+        _compare(eng, f"SELECT date_trunc('{unit}', ts) AS b, "
+                      "sum(v) AS s, avg(v) AS a FROM t "
+                      f"GROUP BY date_trunc('{unit}', ts) ORDER BY b")
+    _compare(eng, "SELECT year(ts) AS yy, g, sum(v) AS s FROM t "
+                  "GROUP BY year(ts), g ORDER BY yy, g")
+    _compare(eng, "SELECT month(ts) AS mm, sum(v) AS s FROM t "
+                  "GROUP BY month(ts) ORDER BY mm")
+
+
+def test_interval_containment():
+    eng = _cubed()
+    # whole-month interval: every touched cube bucket is contained
+    rec = _compare(eng, "SELECT g, sum(v) AS s FROM t "
+                        "WHERE ts >= TIMESTAMP '1997-03-01' AND "
+                        "ts < TIMESTAMP '1997-06-01' "
+                        "GROUP BY g ORDER BY g")
+    assert rec["path"] == "cube"
+    # mid-month boundary straddles a cube bucket -> base path, exact
+    _compare(eng, "SELECT g, sum(v) AS s FROM t "
+                  "WHERE ts >= TIMESTAMP '1997-03-15' "
+                  "GROUP BY g ORDER BY g", expect_cube=False)
+    # year(ts) predicate extracts to a calendar-aligned interval
+    _compare(eng, "SELECT g, sum(v) AS s FROM t WHERE year(ts) = 1997 "
+                  "GROUP BY g ORDER BY g")
+
+
+def test_smallest_covering_cube_wins():
+    eng = _cubed()
+    eng.sql("CREATE DRUID CUBE tiny ON t DIMENSIONS (g) "
+            "GRANULARITY all AGGREGATES (sum(v))")
+    rec = _compare(eng, "SELECT g, sum(v) AS s FROM t "
+                        "GROUP BY g ORDER BY g")
+    assert rec["cube"] == "tiny"  # fewer rows than the month cube
+    # the big cube still serves what tiny can't
+    rec = _compare(eng, "SELECT g, sum(v) AS s FROM t WHERE r = 'A' "
+                        "GROUP BY g ORDER BY g")
+    assert rec["cube"] == "c"
+
+
+# ------------------------------------------------------------ refusals
+
+
+def test_rewrite_refusals_fall_back_to_base():
+    eng = _cubed()
+    # filter on a non-cube dim
+    _compare(eng, "SELECT g, sum(v) AS s FROM t WHERE u > 10 "
+                  "GROUP BY g ORDER BY g", expect_cube=False)
+    # uncovered aggregation (min over a column only sum is stored for)
+    _compare(eng, "SELECT g, min(v) AS m FROM t GROUP BY g ORDER BY g",
+             expect_cube=False)
+    # grouping dim outside the cube
+    _compare(eng, "SELECT u, sum(v) AS s FROM t GROUP BY u "
+                  "ORDER BY u LIMIT 5", expect_cube=False)
+    # finer grain than the cube materializes
+    _compare(eng, "SELECT date_trunc('day', ts) AS d, sum(v) AS s "
+                  "FROM t GROUP BY date_trunc('day', ts) "
+                  "ORDER BY d LIMIT 5", expect_cube=False)
+    refused = eng.metrics.counter("cube_rewrite_total")
+    assert refused.value(result="refused") >= 4
+
+
+def test_scan_and_select_never_touch_cubes():
+    eng = _cubed()
+    out = eng.sql("SELECT g, v FROM t LIMIT 5")
+    assert len(out) == 5
+    assert dict(eng.history[-1]).get("path") != "cube"
+
+
+# --------------------------------------------------- invalidation/stale
+
+
+def test_stale_generation_never_served_and_refresh_recovers():
+    eng = _cubed()
+    q = "SELECT g, sum(v) AS s FROM t GROUP BY g ORDER BY g"
+    assert _compare(eng, q)["path"] == "cube"
+    # re-ingest DIFFERENT data: the cube is stale the same instant
+    eng.register_table("t", _df(seed=99), time_column="ts",
+                       block_rows=1 << 11, time_partition="month")
+    n0 = len(eng.history)
+    a = eng.sql(q)
+    recs = [dict(m) for m in eng.history[n0:]]
+    assert all(r.get("path") != "cube" for r in recs), "stale serve!"
+    # the answer reflects the NEW data (base path, exact)
+    expect = _df(seed=99).groupby("g", as_index=False)["v"].sum() \
+        .rename(columns={"v": "s"})
+    pd.testing.assert_frame_equal(
+        a, expect.sort_values("g").reset_index(drop=True))
+    row = eng.sql("SELECT stale, status FROM sys.cubes "
+                  "WHERE name = 'c'").iloc[0]
+    assert bool(row["stale"]) and row["status"] == "ready"
+    # REFRESH rebuilds against the new generation; serves resume
+    out = eng.sql("REFRESH DRUID CUBES")
+    assert list(out["status"]) == ["ok"]
+    rec = _compare(eng, q)
+    assert rec["path"] == "cube"
+    assert eng.metrics.counter("cube_rewrite_total") \
+        .value(result="stale") >= 1
+
+
+def test_drop_table_cascades_to_cubes():
+    eng = _cubed()
+    assert eng.catalog.maybe("__cube_c") is not None
+    eng.drop_table("t")
+    assert eng.cubes.names() == []
+    assert eng.catalog.maybe("__cube_c") is None
+
+
+def test_auto_refresh_maintainer_rebuilds():
+    eng = _cubed(cube_auto_refresh=True,
+                 cube_refresh_interval_s=0.05)
+    eng.register_table("t", _df(seed=3), time_column="ts",
+                       block_rows=1 << 11, time_partition="month")
+    import time
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        e = eng.cubes.get("c")
+        if e.ready and not e.snapshot_row(eng)["stale"]:
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("maintainer did not rebuild the stale cube")
+    rec = _compare(eng, "SELECT g, sum(v) AS s FROM t "
+                        "GROUP BY g ORDER BY g")
+    assert rec["path"] == "cube"
+    eng.cubes.stop()
+
+
+def test_auto_refresh_enabled_at_runtime_starts_maintainer():
+    """Flipping cube_auto_refresh on AFTER the cubes were created must
+    still start the maintainer at the next ingest (the lazy-start
+    contract covers runtime config mutation too)."""
+    eng = _cubed()  # created with cube_auto_refresh=False
+    eng.config.cube_auto_refresh = True
+    eng.config.cube_refresh_interval_s = 0.05
+    eng.register_table("t", _df(seed=4), time_column="ts",
+                       block_rows=1 << 11, time_partition="month")
+    import time
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        row = eng.cubes.get("c").snapshot_row(eng)
+        if row["status"] == "ready" and not row["stale"]:
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("runtime-enabled maintainer did not rebuild")
+    eng.cubes.stop()
+
+
+# ------------------------------------------------------- DDL + surfaces
+
+
+def test_ddl_create_sys_cubes_contract_and_drop():
+    eng = _engine()
+    out = eng.sql("CREATE DRUID CUBE c ON t DIMENSIONS (g, r) "
+                  "GRANULARITY month AGGREGATES (sum(v), count(*))")
+    assert list(out["status"]) == ["ready"]
+    row = eng.sql("SELECT * FROM sys.cubes").iloc[0]
+    assert row["name"] == "c" and row["base_table"] == "t"
+    assert row["dims"] == "g,r" and row["granularity"] == "month"
+    assert row["rows"] > 0 and row["serve_count"] == 0
+    assert row["base_generation"] == row["cube_generation"]
+    assert not row["stale"] and row["storage_bytes"] > 0
+    # the backing store is an ordinary catalog table: queryable SQL
+    stored = eng.sql("SELECT count(*) AS n FROM __cube_c")
+    assert int(stored["n"][0]) == int(row["rows"])
+    eng.sql("SELECT g, sum(v) AS s FROM t GROUP BY g")
+    assert int(eng.sql("SELECT serve_count FROM sys.cubes")
+               ["serve_count"][0]) == 1
+    out = eng.sql("DROP DRUID CUBE c")
+    assert list(out["status"]) == ["dropped"]
+    assert len(eng.sql("SELECT * FROM sys.cubes")) == 0
+    assert eng.catalog.maybe("__cube_c") is None
+
+
+def test_ddl_errors_are_user_errors():
+    from tpu_olap.resilience.errors import UserError
+    eng = _engine()
+    with pytest.raises(UserError):
+        eng.sql("CREATE DRUID CUBE c ON t DIMENSIONS (nope) "
+                "AGGREGATES (sum(v))")
+    with pytest.raises(UserError):
+        eng.sql("CREATE DRUID CUBE c ON t AGGREGATES (median(v))")
+    with pytest.raises(UserError):
+        eng.sql("CREATE DRUID CUBE c ON missing AGGREGATES (sum(v))")
+    # a failed create must leave no half-registered serveable cube
+    assert not any(eng.cubes.get(n).ready for n in eng.cubes.names())
+
+
+def test_create_cubes_from_file_and_spec_roundtrip(tmp_path):
+    eng = _engine()
+    spec = CubeSpec(name="f1", datasource="t", dimensions=("g",),
+                    granularity="month", aggregations=("sum(v)",))
+    path = tmp_path / "cubes.json"
+    path.write_text(json.dumps(
+        {"cubes": [spec.to_json(),
+                   {"name": "bad", "datasource": "missing",
+                    "aggregations": ["sum(v)"]}]}))
+    out = eng.sql(f"CREATE DRUID CUBES FROM '{path}'")
+    by_name = {r["cube"]: r["status"] for r in out.to_dict("records")}
+    assert by_name["f1"] == "ready" and by_name["bad"] == "error"
+    rec = _compare(eng, "SELECT g, sum(v) AS s FROM t "
+                        "GROUP BY g ORDER BY g")
+    assert rec["cube"] == "f1"
+
+
+def test_debug_cubes_endpoint():
+    from tpu_olap.api.server import QueryServer
+    eng = _cubed()
+    eng.sql("SELECT g, sum(v) AS s FROM t GROUP BY g")
+    srv = QueryServer(eng).start()
+    try:
+        with urllib.request.urlopen(srv.url + "/debug/cubes") as r:
+            payload = json.loads(r.read())
+    finally:
+        srv.stop()
+    assert payload["enabled"] is True
+    (row,) = payload["cubes"]
+    assert row["name"] == "c" and row["serve_count"] >= 1
+
+
+def test_workload_attribution_path_cube():
+    """Cube serves land in the profiler under path='cube', so
+    sys.query_templates shows cube coverage per template."""
+    eng = _cubed()
+    sql = "SELECT g, sum(v) AS s FROM t GROUP BY g ORDER BY g"
+    for _ in range(3):
+        eng.sql(sql)
+    tid = dict(eng.history[-1])["template_id"]
+    row = eng.sql(
+        "SELECT paths, count FROM sys.query_templates "
+        f"WHERE template_id = '{tid}'").iloc[0]
+    assert json.loads(row["paths"]).get("cube") == 3
+
+
+def test_ddl_quoted_literals_with_parens_and_commas():
+    """Filter literals containing parens/commas are text, not list
+    structure, for the CREATE DRUID CUBE clause parser."""
+    eng = _engine()
+    eng.sql("CREATE DRUID CUBE q ON t DIMENSIONS (g) GRANULARITY all "
+            "AGGREGATES (sum(CASE WHEN r = 'A)' THEN v ELSE 0 END), "
+            "sum(CASE WHEN g = 'x,(y' THEN v ELSE 0 END), count(*))")
+    row = eng.sql("SELECT status FROM sys.cubes "
+                  "WHERE name = 'q'").iloc[0]
+    assert row["status"] == "ready"
+
+
+def test_failed_build_not_retried_until_generation_moves():
+    """A deterministically-failing spec is attempted once per base
+    generation — the maintainer must not re-run a doomed device pass
+    every tick (and refresh_now must skip it too)."""
+    from tpu_olap.resilience.errors import UserError
+    eng = _cubed()
+    with pytest.raises(UserError):
+        # median has no device lowering: the build fails the same way
+        # at every generation
+        eng.sql("CREATE DRUID CUBE doomed ON t DIMENSIONS (g) "
+                "GRANULARITY all AGGREGATES (median(v))")
+    builds0 = eng.metrics.counter("cube_builds_total") \
+        .value(result="error")
+    assert eng.cubes.get("doomed") not in eng.cubes.stale_cubes()
+    assert eng.cubes.refresh_now() == {}  # nothing stale to retry
+    assert eng.metrics.counter("cube_builds_total") \
+        .value(result="error") == builds0
+    # a real ingest IS a reason to retry (the new data may fit)
+    eng.register_table("t", _df(seed=1), time_column="ts",
+                       block_rows=1 << 11, time_partition="month")
+    assert any(e.spec.name == "doomed"
+               for e in eng.cubes.stale_cubes())
+
+
+def test_drop_during_inflight_build_leaves_no_orphan_storage():
+    """A build whose entry was dropped mid-flight must not re-register
+    the storage table the drop just removed."""
+    import threading
+    eng = _cubed()
+    entry = eng.cubes.get("c")
+    gate = threading.Event()
+    orig = eng.runner.compute_partials
+
+    def slow(query, table):
+        out = orig(query, table)
+        gate.wait(10)  # hold the build until the drop lands
+        return out
+
+    eng.runner.compute_partials = slow
+    # make the cube stale so refresh_now rebuilds it
+    eng.register_table("t", _df(seed=5), time_column="ts",
+                       block_rows=1 << 11, time_partition="month")
+    t = threading.Thread(target=eng.cubes.refresh_now, daemon=True)
+    t.start()
+    import time
+    time.sleep(0.2)  # let the rebuild reach the gate
+    assert eng.drop_cube("c")
+    gate.set()
+    t.join(30)
+    eng.runner.compute_partials = orig
+    assert eng.catalog.maybe("__cube_c") is None, "orphaned storage"
+    assert eng.cubes.names() == []
+
+
+# --------------------------------------------------- advisor loop
+
+
+def test_advisor_specs_close_the_loop():
+    eng = _engine()
+    sqls = [
+        "SELECT g, sum(v) AS s FROM t WHERE r = 'A' GROUP BY g",
+        "SELECT g, sum(v) AS s FROM t WHERE r = 'B' GROUP BY g",
+        "SELECT year(ts) AS yy, avg(v) AS a FROM t "
+        "GROUP BY year(ts) ORDER BY yy",
+    ]
+    for q in sqls:
+        eng.sql(q)
+    from tpu_olap.cubes import cube_specs_from_workload
+    specs, _notes = cube_specs_from_workload(
+        eng.runner.workload.snapshot(), eng)
+    assert specs, "advisor produced no specs"
+    for s in specs:
+        eng.create_cube(s)  # accepted verbatim
+    for q in sqls:
+        rec = _compare(eng, q)
+        assert rec["path"] == "cube", q
+
+
+def test_batch_path_serves_from_cube():
+    eng = _cubed()
+    sqls = ["SELECT g, sum(v) AS s FROM t GROUP BY g ORDER BY g",
+            "SELECT r, count(*) AS n FROM t GROUP BY r ORDER BY r"]
+    n0 = len(eng.history)
+    outs = eng.sql_batch(sqls)
+    recs = [dict(m) for m in eng.history[n0:]]
+    assert all(r.get("path") == "cube" for r in recs)
+    eng.config.cube_rewrite_enabled = False
+    try:
+        base = [eng.sql(q) for q in sqls]
+    finally:
+        eng.config.cube_rewrite_enabled = True
+    for a, b in zip(outs, base):
+        pd.testing.assert_frame_equal(a, b)
+
+
+# -------------------------------------------------------- unit helpers
+
+
+def test_period_containment_ladder():
+    assert period_contains("P1Y", "P1M")
+    assert period_contains("P3M", "P1M")
+    assert period_contains("P1M", "P1D")
+    assert period_contains("P1W", "P1D")
+    assert not period_contains("P1M", "P1W")
+    assert not period_contains("P1Y", "P1W")
+    assert not period_contains("P1D", "P1M")
+    assert period_contains("P1D", "P1D")
+
+
+def test_agg_signature_resolves_virtual_columns():
+    eng = _engine()
+    p1 = eng.planner.plan("SELECT sum(v * 2) AS a FROM t")
+    p2 = eng.planner.plan("SELECT sum(v * 2) AS b FROM t")
+    p3 = eng.planner.plan("SELECT sum(v * 3) AS a FROM t")
+
+    def sig(plan):
+        vex = {v.name: v.expression
+               for v in plan.query.virtual_columns}
+        return agg_signature(plan.query.aggregations[0], vex)
+
+    assert sig(p1) == sig(p2)
+    assert sig(p1) != sig(p3)

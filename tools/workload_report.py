@@ -18,6 +18,14 @@ Three sources:
         workload-smoke gate. Exits non-zero when the profile or
         `SELECT COUNT(*) FROM sys.queries` comes back empty.
     ... --json   emit the raw payload as JSON instead of the table.
+    ... --emit-cubes out.json
+        Additionally write the ranked recommendations as
+        machine-readable cube specs (tpu_olap.cubes.advisor) that the
+        materializer accepts VERBATIM: load them with
+        `CREATE DRUID CUBES FROM 'out.json'` or
+        `Engine.create_cube(spec)` — the advisor -> materializer loop
+        (docs/CUBES.md). Requires --selftest (the spec assembly needs
+        the engine's catalog metadata, not just the HTTP payload).
 """
 
 import argparse
@@ -143,6 +151,20 @@ def _selftest_payload():
                "recommendations": recommend_rollups(rows)}
     print(f"selftest: {n_queries} recorded queries, "
           f"{len(rows)} templates, sys.* surface OK\n")
+    return payload, eng
+
+
+def emit_cube_specs(eng, out_path: str, top: int = 8) -> dict:
+    """Write the advisor's ranked recommendations as cube specs the
+    materializer accepts verbatim (docs/CUBES.md advisor workflow)."""
+    from tpu_olap.cubes import cube_specs_from_workload
+    rows = eng.runner.workload.snapshot()
+    specs, notes = cube_specs_from_workload(rows, eng, top=top)
+    payload = {"cubes": [s.to_json() for s in specs], "notes": notes}
+    with open(out_path, "w") as f:
+        json.dump(payload, f, indent=1)
+    print(f"wrote {len(specs)} cube spec(s) to {out_path}"
+          + (f" ({len(notes)} note(s))" if notes else ""))
     return payload
 
 
@@ -159,10 +181,23 @@ def main(argv=None):
                    help="templates to print (default 10)")
     p.add_argument("--json", action="store_true",
                    help="emit the raw payload as JSON")
+    p.add_argument("--emit-cubes", metavar="OUT.json", default=None,
+                   help="write the ranked recommendations as cube "
+                        "specs the materializer accepts verbatim "
+                        "(CREATE DRUID CUBES FROM '<file>'); needs "
+                        "--selftest")
     args = p.parse_args(argv)
     if bool(args.url) == bool(args.selftest):
         p.error("pass exactly one of --url or --selftest")
-    payload = _fetch(args.url) if args.url else _selftest_payload()
+    if args.emit_cubes and not args.selftest:
+        p.error("--emit-cubes needs --selftest (spec assembly reads "
+                "catalog metadata)")
+    if args.url:
+        payload, eng = _fetch(args.url), None
+    else:
+        payload, eng = _selftest_payload()
+    if args.emit_cubes:
+        emit_cube_specs(eng, args.emit_cubes, top=args.top)
     if args.json:
         print(json.dumps(payload, indent=2, default=str))
     else:

@@ -103,6 +103,12 @@ class Engine:
         # / sys.caches resolve through the catalog to live-state frames
         # served on the interpreter path with accounting suppressed
         self.catalog.sys_provider = SysTableProvider(self)
+        # materialized rollup cubes (tpu_olap.cubes; docs/CUBES.md):
+        # registry of (dim subset x grain) partial-aggregate rollups;
+        # the planner's cube-rewrite pass serves covered aggregates
+        # from them, the background maintainer rebuilds stale ones
+        from tpu_olap.cubes import CubeRegistry
+        self.cubes = CubeRegistry(self)
 
     # ------------------------------------------------------- registration
 
@@ -220,6 +226,10 @@ class Engine:
             rows=segments.num_rows if segments is not None else None,
             segments=len(segments.segments) if segments is not None
             else 0)
+        # cube cascade (docs/CUBES.md): rollups over this table are now
+        # stale — the rewrite pass stops serving them at generation-
+        # check time; the maintainer wakes to rebuild
+        self.cubes.on_table_registered(name)
         return entry
 
     def register_lookup(self, name: str, mapping: dict):
@@ -308,6 +318,17 @@ class Engine:
             if out is not None:
                 return out
         device_ms = 0.0  # user-visible time burned on a failed device try
+        if plan.rewritten and self.cubes.active:
+            # aggregate rewrite onto a materialized rollup cube
+            # (planner.cuberewrite; docs/CUBES.md): a covered query is
+            # served by folding thousands of stored cube rows instead
+            # of scanning the base table — None falls through to the
+            # ordinary device path, never an error
+            from tpu_olap.planner.cuberewrite import try_serve_cube
+            res = try_serve_cube(self, plan)
+            if res is not None:
+                with _span("render"):
+                    return self._frame_from(plan, res)
         if plan.rewritten:
             res = None
             t_dev = time.perf_counter()
@@ -537,6 +558,17 @@ class Engine:
                         continue
                 with root.span("plan", query_id=qids[i]):
                     plan = self.planner.plan(q)
+                if plan.rewritten and self.cubes.active:
+                    # cube-covered statements serve immediately (their
+                    # record carries the statement's own query_id) and
+                    # never join a fused base-table scan they don't need
+                    from tpu_olap.planner.cuberewrite import \
+                        try_serve_cube
+                    with use_query_id(qids[i]):
+                        res = try_serve_cube(self, plan)
+                    if res is not None:
+                        outs[i] = self._frame_from(plan, res)
+                        continue
                 plans[i] = plan
                 stmt = getattr(plan, "stmt", None)
                 if plan.rewritten and not (
@@ -767,7 +799,26 @@ class Engine:
         with self.device_lock:
             self.runner.clear_cache(name)
         self.catalog.drop(name)
+        # cube cascade: rollups over a dropped base are dropped too
+        # (their storage tables unregister with them)
+        self.cubes.on_table_dropped(name)
         self.runner.events.emit("drop", table=name)
+
+    # -------------------------------------------------------------- cubes
+
+    def create_cube(self, spec):
+        """Materialize a rollup cube (docs/CUBES.md). `spec` is a
+        CubeSpec or its JSON dict: {name, datasource, dimensions,
+        granularity, aggregations[, virtualColumns]} — the same payload
+        `CREATE DRUID CUBES FROM '<file>'` reads and
+        `tools/workload_report.py --emit-cubes` writes. Builds
+        synchronously on the device; returns the registry entry."""
+        return self.cubes.create(spec)
+
+    def drop_cube(self, name: str) -> bool:
+        """DROP DRUID CUBE analog: unregister the cube and its backing
+        segment table. Returns False when no such cube exists."""
+        return self.cubes.drop(name)
 
     @property
     def history(self):
@@ -800,6 +851,20 @@ _EXEC_RE = _re.compile(
 _SEARCH_RE = _re.compile(
     r"^\s*search\s+druid\s+datasource\s+(\w+)\s+for\s+'((?:[^']|'')*)'"
     r"(?:\s+in\s+([\w\s,]+?))?(?:\s+limit\s+(\d+))?\s*;?\s*$", _re.I)
+# rollup-cube DDL (docs/CUBES.md): CREATE DRUID CUBE <name> ON <table>
+# [DIMENSIONS (a, b)] [GRANULARITY g] AGGREGATES (sum(x), ...);
+# CREATE DRUID CUBES FROM '<specs.json>'; DROP DRUID CUBE <name>;
+# REFRESH DRUID CUBES
+_CREATE_CUBE_RE = _re.compile(
+    r"^\s*create\s+druid\s+cube\s+(\w+)\s+on\s+(\w+)\s+(.*?)\s*;?\s*$",
+    _re.I | _re.S)
+_CREATE_CUBES_FROM_RE = _re.compile(
+    r"^\s*create\s+druid\s+cubes\s+from\s+'((?:[^']|'')+)'\s*;?\s*$",
+    _re.I)
+_DROP_CUBE_RE = _re.compile(
+    r"^\s*drop\s+druid\s+cube\s+(\w+)\s*;?\s*$", _re.I)
+_REFRESH_CUBES_RE = _re.compile(
+    r"^\s*refresh\s+druid\s+cubes\s*;?\s*$", _re.I)
 # cheap pre-parse hint that a statement MIGHT reference a sys.* virtual
 # datasource (catalog.systables): a match still confirms against the
 # parsed tree before taking the introspection path
@@ -830,7 +895,182 @@ def _match_verb(query: str):
             if m.group(3) else ()
         limit = int(m.group(4)) if m.group(4) else 1000
         return lambda eng: _run_search_verb(eng, ds, pat, dims, limit)
+    m = _CREATE_CUBE_RE.match(query)
+    if m:
+        name, base, clauses = m.group(1), m.group(2), m.group(3)
+        return lambda eng: _run_create_cube(eng, name, base, clauses)
+    m = _CREATE_CUBES_FROM_RE.match(query)
+    if m:
+        path = m.group(1).replace("''", "'")
+        return lambda eng: _run_create_cubes_from(eng, path)
+    m = _DROP_CUBE_RE.match(query)
+    if m:
+        name = m.group(1)
+        return lambda eng: _run_drop_cube(eng, name)
+    if _REFRESH_CUBES_RE.match(query):
+        return _run_refresh_cubes
     return None
+
+
+# ------------------------------------------------------------- cube DDL
+
+_CUBE_CLAUSE_RE = _re.compile(
+    r"(dimensions|aggregates|granularity)\b\s*", _re.I)
+
+
+def _scan_quote(s: str, i: int) -> int:
+    """Index just past the SQL string literal starting at s[i] == "'"
+    ('' is the escape). Unterminated -> len(s)."""
+    i += 1
+    n = len(s)
+    while i < n:
+        if s[i] == "'":
+            if i + 1 < n and s[i + 1] == "'":
+                i += 2
+                continue
+            return i + 1
+        i += 1
+    return n
+
+
+def _split_top_commas(s: str) -> list[str]:
+    """Comma split at paren depth 0, quote-aware (aggregate lists nest
+    parens, and filter literals may contain commas/parens)."""
+    out, depth, cur, i, n = [], 0, [], 0, len(s)
+    while i < n:
+        ch = s[i]
+        if ch == "'":
+            j = _scan_quote(s, i)
+            cur.append(s[i:j])
+            i = j
+            continue
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            out.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(ch)
+        i += 1
+    tail = "".join(cur).strip()
+    if tail:
+        out.append(tail)
+    return out
+
+
+def _parse_cube_clauses(clauses: str) -> dict:
+    """DIMENSIONS (...) / GRANULARITY g / AGGREGATES (...) in any
+    order -> spec fields. Parenthesized lists are matched by depth so
+    aggregate expressions may contain commas and parens."""
+    out = {"dimensions": (), "granularity": "all", "aggregations": ()}
+    i, n = 0, len(clauses)
+    while i < n:
+        m = _CUBE_CLAUSE_RE.match(clauses, i)
+        if m is None:
+            if clauses[i].isspace():
+                i += 1
+                continue
+            raise UserError(
+                f"cannot parse CREATE DRUID CUBE clause at "
+                f"{clauses[i:i + 40]!r}")
+        kw = m.group(1).lower()
+        i = m.end()
+        if kw == "granularity":
+            g = _re.match(r"\s*(\w+)", clauses[i:])
+            if g is None:
+                raise UserError("GRANULARITY needs a grain name")
+            out["granularity"] = g.group(1)
+            i += g.end()
+            continue
+        j = clauses.find("(", i)
+        if j < 0 or clauses[i:j].strip():
+            # junk between the keyword and its list must not silently
+            # drop items (DIMENSIONS cat (region) would lose `cat`)
+            raise UserError(f"{kw.upper()} needs a parenthesized list")
+        depth, k = 0, j
+        while k < n:
+            c = clauses[k]
+            if c == "'":
+                # parens/commas inside a filter literal (e.g.
+                # FILTER (WHERE cat = 'a)')) are text, not structure
+                k = _scan_quote(clauses, k)
+                continue
+            if c == "(":
+                depth += 1
+            elif c == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+            k += 1
+        if depth != 0:
+            raise UserError(f"unbalanced parens in {kw.upper()} list")
+        items = _split_top_commas(clauses[j + 1:k])
+        if kw == "dimensions":
+            out["dimensions"] = tuple(items)
+        else:
+            out["aggregations"] = tuple(items)
+        i = k + 1
+    return out
+
+
+def _cube_status_frame(rows) -> pd.DataFrame:
+    return pd.DataFrame(rows, columns=["cube", "status", "detail"])
+
+
+def _run_create_cube(eng: Engine, name, base, clauses) -> pd.DataFrame:
+    from tpu_olap.cubes import CubeSpec
+    fields = _parse_cube_clauses(clauses)
+    spec = CubeSpec(name=name, datasource=base, source="ddl", **fields)
+    entry = eng.create_cube(spec)
+    return _cube_status_frame([{
+        "cube": name, "status": entry.status,
+        "detail": f"{entry.data.n_rows} rows @ {spec.granularity} "
+                  f"in {entry.build_ms:.0f} ms"}])
+
+
+def _run_create_cubes_from(eng: Engine, path: str) -> pd.DataFrame:
+    """CREATE DRUID CUBES FROM '<file.json>': materialize every spec in
+    the file (a list, or {"cubes": [...]} — the exact artifact
+    tools/workload_report.py --emit-cubes writes). Per-spec isolation:
+    one bad spec reports its error without aborting the rest."""
+    with open(path) as f:
+        payload = _json.load(f)
+    specs = payload.get("cubes", payload) if isinstance(payload, dict) \
+        else payload
+    if not isinstance(specs, list):
+        raise UserError(f"{path!r}: expected a list of cube specs")
+    rows = []
+    for s in specs:
+        cname = (s or {}).get("name", "?") if isinstance(s, dict) else "?"
+        try:
+            entry = eng.create_cube(s)
+            rows.append({"cube": entry.spec.name,
+                         "status": entry.status,
+                         "detail": f"{entry.data.n_rows} rows in "
+                                   f"{entry.build_ms:.0f} ms"})
+        except Exception as e:  # noqa: BLE001 — per-spec isolation
+            rows.append({"cube": cname, "status": "error",
+                         "detail": str(e)[:300]})
+    return _cube_status_frame(rows)
+
+
+def _run_drop_cube(eng: Engine, name: str) -> pd.DataFrame:
+    found = eng.drop_cube(name)
+    return _cube_status_frame([{
+        "cube": name, "status": "dropped" if found else "absent",
+        "detail": ""}])
+
+
+def _run_refresh_cubes(eng: Engine) -> pd.DataFrame:
+    results = eng.cubes.refresh_now()
+    if not results:
+        return _cube_status_frame([])
+    return _cube_status_frame([
+        {"cube": n, "status": "ok" if r == "ok" else "error",
+         "detail": "" if r == "ok" else r}
+        for n, r in sorted(results.items())])
 
 
 def _run_clear(eng: Engine, table: str | None) -> pd.DataFrame:

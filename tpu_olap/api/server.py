@@ -32,6 +32,10 @@ equivalent is a JSON-over-HTTP surface (stdlib only, no new deps):
   GET  /debug/cache  semantic result-cache state: per-tier entries/
                      bytes/hits/misses/evictions + per-table ingest
                      generations (docs/CACHING.md)
+  GET  /debug/cubes  materialized rollup cubes (tpu_olap.cubes):
+                     per cube dims/grain/rows, base-vs-cube generation,
+                     last refresh, build cost, and rewrite serve counts
+                     — the SQL spelling is SELECT * FROM sys.cubes
   GET  /debug/workload  the query-template profiler (obs.workload):
                      top templates with latency percentiles and cache
                      hit-rates, plus ranked rollup-cube recommendations
@@ -361,6 +365,16 @@ class QueryServer:
             return {"totals": prof.totals(),
                     "templates": rows[:n] if n else rows,
                     "recommendations": recommend_rollups(rows)}
+        if path == "/debug/cubes" or path.startswith("/debug/cubes?"):
+            # materialized rollup cubes (tpu_olap.cubes; docs/CUBES.md):
+            # per cube name/base/dims/grain/rows, base-vs-cube
+            # generation (stale detection), last refresh, build cost,
+            # and rewrite serve counts — the SQL spelling is
+            # SELECT * FROM sys.cubes
+            eng = self.engine
+            return {"enabled": bool(eng.config.cube_rewrite_enabled),
+                    "auto_refresh": bool(eng.config.cube_auto_refresh),
+                    "cubes": eng.cubes.snapshot()}
         if path == "/debug/cache" or path.startswith("/debug/cache?"):
             # semantic result-cache state (executor.resultcache;
             # docs/CACHING.md): per-tier entries/bytes/hit counters plus

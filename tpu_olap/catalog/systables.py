@@ -21,6 +21,9 @@ Datasources (column tables in docs/OBSERVABILITY.md):
                        latency percentiles, cache hit-rate, dims, grains
   sys.metrics          the metrics registry, one row per series
   sys.caches           result-cache tiers + runner cache populations
+  sys.cubes            materialized rollup cubes: dims/grain/rows,
+                       base-vs-cube generation (stale detection),
+                       build cost, rewrite serve counts (docs/CUBES.md)
 """
 
 from __future__ import annotations
@@ -171,6 +174,23 @@ def _metrics_frame(engine) -> pd.DataFrame:
         "name", "kind", "labels", "value", "count", "total"])
 
 
+_CUBE_COLS = (
+    "name", "base_table", "table", "dims", "granularity", "status",
+    "rows", "base_generation", "cube_generation", "stale",
+    "last_refresh_ms", "build_ms", "refreshes", "serve_count",
+    "storage_bytes", "sketch_bytes", "error")
+
+
+def _cubes_frame(engine) -> pd.DataFrame:
+    """sys.cubes: the materialized-rollup registry (tpu_olap.cubes) —
+    per cube: dims/grain, row count, the base table's LIVE ingest
+    generation vs the generation the cube was built from (stale =
+    mismatch: unservable until the maintainer rebuilds), build cost,
+    and how many queries the rewrite pass served from it."""
+    return pd.DataFrame(engine.cubes.snapshot(),
+                        columns=list(_CUBE_COLS))
+
+
 def _caches_frame(engine) -> pd.DataFrame:
     runner = engine.runner
     snap = runner.result_cache.snapshot()
@@ -209,6 +229,7 @@ class SysTableProvider:
         "sys.query_templates": _templates_frame,
         "sys.metrics": _metrics_frame,
         "sys.caches": _caches_frame,
+        "sys.cubes": _cubes_frame,
     }
 
     def __init__(self, engine):

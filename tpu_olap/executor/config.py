@@ -153,6 +153,35 @@ class EngineConfig:
     # it bypass tier 1 (the plain packed/partials path serves them)
     segment_cache_state_budget: int = 1 << 22
 
+    # --- materialized rollup cubes (tpu_olap.cubes; docs/CUBES.md) ---
+    # cube_rewrite_enabled gates the planner's aggregate-rewrite pass:
+    # a covered aggregate is served by folding a registered cube's
+    # stored partials instead of scanning the base table. Cubes only
+    # exist once created (DDL / Engine.create_cube / advisor specs), so
+    # the default-on flag costs one dict probe per query until then.
+    cube_rewrite_enabled: bool = True
+    # background maintainer: rebuild cubes whose base table's ingest
+    # generation moved (stale cubes are never served either way — the
+    # rewrite pass checks the generation first, mirroring the semantic
+    # result cache's invalidation contract). False = refresh only via
+    # REFRESH DRUID CUBES / CubeRegistry.refresh_now (deterministic for
+    # tests and bench phases).
+    cube_auto_refresh: bool = True
+    cube_refresh_interval_s: float = 2.0
+    # serve-time fold budget: max [groups x per-agg state radix]
+    # elements the host fold may allocate (HLL register files / theta
+    # tables scale it exactly like segment_cache_state_budget)
+    cube_serve_state_budget: int = 1 << 22
+    # serve-cost bailout: only serve from a cube when its (interval-
+    # kept) row count is at least this factor smaller than the base
+    # rows the query would scan after pruning. Measured on the SF10
+    # bench (BENCH_CUBES.json): the pruned columnar scan moves ~130k
+    # rows/ms where the host fold moves ~34k rows/ms, so break-even is
+    # ~4x row reduction — 16 serves only clear wins and leaves
+    # marginally-covered queries (manifest pruning already made them
+    # fast) un-pessimized on the base path. <= 1 disables the check.
+    cube_serve_min_reduction: float = 16.0
+
     # execution platform: "device" = default jax backend, "cpu" = numpy path
     platform: str = "device"
 

@@ -461,6 +461,10 @@ class QueryRunner:
             # partial hits keep their real dispatch path — a device pass
             # still computed the uncached segments)
             return "cache"
+        if m.get("cube"):
+            # served by the aggregate rewrite from a materialized
+            # rollup cube (planner.cuberewrite; docs/CUBES.md)
+            return "cube"
         if m.get("batch_dedup") or m.get("batch_legs", 0) > 1:
             return "batch"
         if m.get("sparse"):
@@ -773,6 +777,48 @@ class QueryRunner:
     def _next_batch_id(self) -> int:
         self._batch_seq += 1
         return self._batch_seq
+
+    def compute_partials(self, query, table):
+        """Run an aggregation query and return its RAW mergeable
+        partials instead of finalized rows — the cube materializer's
+        entry point (tpu_olap.cubes; docs/CUBES.md). Returns
+        (plan, present flat group ids [G] int64, {name: [G, ...] compact
+        partial arrays}, metrics). Rides the ordinary machinery: cached
+        lowering, admission slot, breaker check, the dense partials or
+        sparse dispatch path — so background cube builds queue behind
+        (and shed with) foreground traffic instead of around it. No
+        deadline wrapping: a rollup over the whole table is legitimate
+        long-running background work."""
+        from tpu_olap.kernels.groupby import UnsupportedAggregation
+
+        with self.admission.slot(self.config.query_deadline_s):
+            self.breaker.check()
+            metrics = self._last_metrics = {}
+            with _span("lower"):
+                plan = self._lower_cached(query, table)
+            if plan.kind != "agg":
+                raise UnsupportedAggregation(
+                    f"{query.query_type} has no mergeable partials")
+            if plan.sparse:
+                from tpu_olap.kernels.sparse_groupby import SENTINEL
+                out, _ = self._dispatch(
+                    lambda: self._run_sparse(plan, metrics), metrics,
+                    table.name)
+                keys = np.asarray(out["_keys"])
+                pm = keys != SENTINEL
+                present = keys[pm].astype(np.int64)
+                compact = {k: np.asarray(v)[pm] for k, v in out.items()
+                           if not k.startswith("_") or k == "_rows"
+                           or k.startswith("_nn_")}
+            else:
+                partials = self._dispatch(
+                    lambda: self._run_partials(plan, metrics), metrics,
+                    table.name)
+                rows = np.asarray(partials["_rows"])
+                present = np.nonzero(rows > 0)[0].astype(np.int64)
+                compact = {k: np.asarray(v)[present]
+                           for k, v in partials.items()}
+        return plan, present, compact, metrics
 
     def _guarded_dispatch(self, call, metrics: dict, table_name: str):
         """_dispatch under the same deadline/wedge guard as the
