@@ -907,6 +907,199 @@ def _cube_main(mode: str) -> int:
     return 0 if parity_ok else 1
 
 
+def _ingest_main() -> int:
+    """`bench.py --ingest-mode`: the real-time ingest bench
+    (docs/INGEST.md), banking BENCH_INGEST.json. Synthetic fact table
+    (INGEST_BASE_ROWS, default 200k — append throughput and
+    query-under-ingest interference do not need SF scale) with a WAL
+    in a temp dir, then four phases:
+
+    1. QUIESCED query p50/p99 — the interference baseline;
+    2. SUSTAINED APPEND throughput: INGEST_BATCH_ROWS-row batches for
+       INGEST_SECONDS with the background compactor live (rows/s
+       includes WAL fsync + snapshot swap + backpressure waits);
+    3. QUERY UNDER INGEST: the same query timed while an appender
+       thread streams batches — p50/p99 vs quiesced is the write-path
+       interference the enqueue-only dispatch lock is supposed to
+       bound;
+    4. CRASH RECOVERY: a fresh engine re-registers the base and
+       replays the WAL — replay wall + rows/s, then compaction wall.
+
+    Parity: the final recovered state must be sha256-identical to a
+    one-shot registration of base + every acknowledged batch."""
+    import hashlib
+    import shutil
+    import tempfile
+    import threading
+
+    import pandas as pd
+
+    from tpu_olap import Engine
+    from tpu_olap.executor import EngineConfig
+    from tpu_olap.resilience.errors import IngestBackpressure
+
+    def note(msg):
+        print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+    base_rows = int(os.environ.get("INGEST_BASE_ROWS", 200_000))
+    batch_rows = int(os.environ.get("INGEST_BATCH_ROWS", 1_000))
+    run_s = float(os.environ.get("INGEST_SECONDS", 3.0))
+    iters = int(os.environ.get("BENCH_ITERS", 5)) * 8
+    fsync = os.environ.get("INGEST_WAL_FSYNC", "always")
+
+    rng = np.random.default_rng(0)
+    base = pd.DataFrame({
+        "ts": pd.to_datetime("1993-01-01") + pd.to_timedelta(
+            rng.integers(0, 86400 * 365, base_rows), unit="s"),
+        "cat": rng.choice([f"c{i:02d}" for i in range(32)], base_rows),
+        "v": rng.integers(0, 10_000, base_rows).astype(np.int64),
+    })
+    wal_dir = tempfile.mkdtemp(prefix="bench-ingest-wal-")
+    mk_cfg = lambda: EngineConfig(  # noqa: E731
+        ingest_wal_dir=wal_dir, ingest_wal_fsync=fsync,
+        ingest_compact_rows=1 << 15, ingest_compact_interval_s=0.25,
+        history_limit=1_000_000)
+    eng = Engine(mk_cfg())
+    t0 = time.perf_counter()
+    eng.register_table("events", base, time_column="ts",
+                       block_rows=1 << 14, time_partition="month")
+    note(f"base ingest: {base_rows} rows in "
+         f"{time.perf_counter() - t0:.2f}s")
+    q = ("SELECT cat, count(*) AS n, sum(v) AS s FROM events "
+         "GROUP BY cat ORDER BY cat")
+
+    def timed(n):
+        ts = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            eng.sql(q)
+            ts.append((time.perf_counter() - t0) * 1000)
+        return {"p50": round(float(np.percentile(ts, 50)), 3),
+                "p99": round(float(np.percentile(ts, 99)), 3)}
+
+    eng.sql(q)  # compile warm-up
+    quiesced = timed(iters)
+    note(f"quiesced: {quiesced}")
+
+    def mk_batch(i):
+        r = np.random.default_rng(1000 + i)
+        return [{"ts": int(pd.Timestamp("1994-01-01").value // 10**6)
+                 + int(x), "cat": f"c{int(c):02d}", "v": int(v)}
+                for x, c, v in zip(
+                    r.integers(0, 86400_000 * 30, batch_rows),
+                    r.integers(0, 32, batch_rows),
+                    r.integers(0, 10_000, batch_rows))]
+
+    # --- phase 2: sustained append throughput (compactor live)
+    appended_batches = []
+    sheds = 0
+    t_start = time.perf_counter()
+    i = 0
+    while time.perf_counter() - t_start < run_s:
+        b = mk_batch(i)
+        try:
+            eng.append("events", b)
+            appended_batches.append(i)
+        except IngestBackpressure:
+            sheds += 1
+            time.sleep(0.05)
+        i += 1
+    append_wall = time.perf_counter() - t_start
+    n_appended = len(appended_batches) * batch_rows
+    append_rps = n_appended / append_wall
+    note(f"sustained append: {n_appended} rows in {append_wall:.2f}s "
+         f"= {append_rps:,.0f} rows/s ({sheds} sheds)")
+
+    # --- phase 3: query under ingest
+    stop = threading.Event()
+
+    def appender():
+        j = 100_000
+        while not stop.is_set():
+            try:
+                eng.append("events", mk_batch(j))
+                appended_batches.append(j)
+            except IngestBackpressure:
+                time.sleep(0.05)
+            j += 1
+
+    th = threading.Thread(target=appender)
+    th.start()
+    try:
+        under_ingest = timed(iters)
+    finally:
+        stop.set()
+        th.join()
+    note(f"under ingest: {under_ingest}")
+    interference = round(
+        under_ingest["p50"] / max(quiesced["p50"], 1e-3), 2)
+
+    # --- phase 4: crash recovery + compaction
+    snap = eng.ingest.snapshot()["tables"]["events"]
+    eng.close()  # flush WAL deterministically, then abandon the engine
+    total_appended = len(appended_batches) * batch_rows
+    rec = Engine(mk_cfg())
+    rec.config.ingest_auto_compact = False
+    t0 = time.perf_counter()
+    rec.register_table("events", base, time_column="ts",
+                       block_rows=1 << 14, time_partition="month")
+    recover_wall = time.perf_counter() - t0
+    ev = [e for e in rec.runner.events.snapshot()
+          if e["event"] == "wal_replay"]
+    replay_ms = ev[0]["ms"] if ev else 0.0
+    replay_rows = ev[0]["rows"] if ev else 0
+    note(f"recovery: register+replay {recover_wall:.2f}s "
+         f"(replay {replay_ms:.0f} ms for {replay_rows} rows)")
+    t0 = time.perf_counter()
+    rec.compact_now("events")
+    compact_s = time.perf_counter() - t0
+
+    # --- parity: recovered state == one-shot registration
+    extra = pd.DataFrame(
+        [r for i in sorted(set(appended_batches)) for r in mk_batch(i)])
+    extra["ts"] = pd.to_datetime(extra["ts"], unit="ms")
+    ref = Engine()
+    ref.register_table("events",
+                       pd.concat([base, extra], ignore_index=True),
+                       time_column="ts", block_rows=1 << 14,
+                       time_partition="month")
+    dig = lambda f: hashlib.sha256(  # noqa: E731
+        f.to_csv(index=False).encode()).hexdigest()
+    parity_ok = dig(rec.sql(q)) == dig(ref.sql(q))
+    note(f"recovery parity: {parity_ok}")
+    rec.close()
+    shutil.rmtree(wal_dir, ignore_errors=True)
+
+    out = {
+        "metric": "ingest_append_rows_per_s",
+        "value": round(append_rps, 1),
+        "unit": "rows/s",
+        "vs_baseline": None,
+        "detail": {
+            "base_rows": base_rows, "batch_rows": batch_rows,
+            "wal_fsync": fsync, "run_s": run_s,
+            "appended_rows_total": total_appended,
+            "backpressure_sheds": sheds,
+            "query_quiesced_ms": quiesced,
+            "query_under_ingest_ms": under_ingest,
+            "under_ingest_p50_interference_x": interference,
+            "recovery": {
+                "register_plus_replay_s": round(recover_wall, 3),
+                "replay_ms": replay_ms, "replay_rows": replay_rows,
+                "replay_rows_per_s": round(
+                    replay_rows / max(replay_ms / 1000, 1e-6), 1),
+                "compact_s": round(compact_s, 3)},
+            "compactions": snap["compactions"],
+            "wal_bytes_final": (snap["wal"] or {}).get("bytes"),
+            "parity_ok": parity_ok,
+        },
+    }
+    with open(os.path.join(REPO, "BENCH_INGEST.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    return 0 if parity_ok else 1
+
+
 def _parse_args(argv=None):
     import argparse
     p = argparse.ArgumentParser(
@@ -938,6 +1131,15 @@ def _parse_args(argv=None):
              "bench's own workload profile, then base-vs-cube p50 with "
              "parity digests, materialization cost, and storage "
              "bytes); banks BENCH_CUBES.json (docs/CUBES.md)")
+    p.add_argument(
+        "--ingest-mode", action="store_true",
+        help="run the real-time ingest bench instead of the latency "
+             "bench: sustained WAL-durable append rows/s, query "
+             "p50/p99 under ingest vs quiesced, crash-recovery replay "
+             "time, and compaction cost, with sha256 recovery parity; "
+             "banks BENCH_INGEST.json (docs/INGEST.md). Knobs: "
+             "INGEST_BASE_ROWS, INGEST_BATCH_ROWS, INGEST_SECONDS, "
+             "INGEST_WAL_FSYNC")
     p.add_argument(
         "--span-summary", action="store_true",
         help="emit per-query per-phase span timings (parse/plan/"
@@ -979,11 +1181,19 @@ def _parse_args(argv=None):
                                        or args.inject_faults):
         p.error("--cube-mode is its own bench; it does not combine "
                 "with the other modes")
+    if args.ingest_mode and (args.concurrency is not None
+                             or args.cache_mode is not None
+                             or args.cube_mode is not None
+                             or args.trace_out or args.inject_faults):
+        p.error("--ingest-mode is its own bench; it does not combine "
+                "with the other modes")
     return args
 
 
 if __name__ == "__main__":
     args = _parse_args()
+    if args.ingest_mode:
+        sys.exit(_ingest_main())
     if args.cube_mode is not None:
         sys.exit(_cube_main(args.cube_mode))
     if args.cache_mode is not None:

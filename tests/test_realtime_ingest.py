@@ -1,0 +1,755 @@
+"""Real-time ingest (ISSUE 13; docs/INGEST.md): durable delta
+segments, WAL crash recovery, and backpressured compaction.
+
+Covers the tentpole contracts:
+- appended rows are queryable immediately alongside sealed segments
+  with exact parity vs a one-shot registration of the same rows (device
+  path, fallback path, and the lexicographic-bound fast path across an
+  append-extended, temporarily-unsorted dictionary);
+- every acknowledged append survives a crash: a fresh engine
+  registering the same base replays the WAL to the exact acknowledged
+  state (sha256-identical query results), a torn WAL tail truncates
+  cleanly, and re-registering a LIVE table resets the log;
+- a full delta sheds with 429 + Retry-After (never a silent drop) and
+  compaction (sync + background) seals deltas into time-partitioned
+  segments, re-sorting the dictionary, without losing racing appends;
+- partial-survival: a delta-only append leaves sealed-segment tier-1
+  cache partials servable (hit-rate > 0) and does NOT stale
+  generation-current cubes — cube serves fold the delta remainder
+  through the base path with zero stale serves;
+- a seeded kill-and-recover chaos suite across the append/wal-write/
+  wal-replay/compact fault sites (append ∥ query ∥ compact ∥ crash →
+  replay → parity).
+"""
+
+import hashlib
+import os
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpu_olap import Engine
+from tpu_olap.executor import EngineConfig
+from tpu_olap.resilience import FaultInjector
+from tpu_olap.resilience.errors import IngestBackpressure, UserError
+
+BLOCK = 512
+
+
+def _df(n=2000, seed=3):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "ts": pd.to_datetime("2022-03-01")
+        + pd.to_timedelta(rng.integers(0, 86400 * 45, n), unit="s"),
+        "g": rng.choice([f"g{i}" for i in range(8)], n),
+        "v": rng.integers(0, 1000, n).astype(np.int64),
+    })
+
+
+def _cfg(**kw):
+    kw.setdefault("ingest_auto_compact", False)
+    kw.setdefault("cube_auto_refresh", False)
+    return EngineConfig(**kw)
+
+
+def _engine(data=None, **kw):
+    eng = Engine(_cfg(**kw))
+    eng.register_table("t", _df() if data is None else data,
+                       time_column="ts", block_rows=BLOCK)
+    return eng
+
+
+def _rows_frame(rows):
+    """Appended row dicts -> the frame a one-shot reference registers."""
+    df = pd.DataFrame(rows)
+    df["ts"] = pd.to_datetime(df["ts"], format="mixed")
+    return df
+
+
+def _reference(extra_rows, n=2000, seed=3):
+    base = _df(n, seed)
+    data = pd.concat([base, _rows_frame(extra_rows)],
+                     ignore_index=True) if extra_rows else base
+    ref = Engine()
+    ref.register_table("t", data, time_column="ts", block_rows=BLOCK)
+    return ref
+
+
+PARITY_QUERIES = [
+    "SELECT g, count(*) AS n, sum(v) AS s FROM t GROUP BY g ORDER BY g",
+    "SELECT month(ts) AS mo, sum(v) AS s, min(v) AS lo, max(v) AS hi "
+    "FROM t GROUP BY month(ts) ORDER BY mo",
+    "SELECT count(*) AS n, sum(v) AS s FROM t WHERE v < 500",
+    "SELECT g, sum(v) AS s FROM t "
+    "WHERE ts >= TIMESTAMP '2022-04-01' GROUP BY g ORDER BY g",
+]
+
+
+def _digest(frame: pd.DataFrame) -> str:
+    return hashlib.sha256(
+        frame.to_csv(index=False).encode()).hexdigest()
+
+
+def _assert_parity(eng, ref, label=""):
+    for q in PARITY_QUERIES:
+        a, b = eng.sql(q), ref.sql(q)
+        assert _digest(a) == _digest(b), \
+            f"{label}: {q}\n{a}\nvs\n{b}"
+
+
+# ------------------------------------------------------------- appends
+
+def test_append_visible_immediately_with_parity():
+    eng = _engine()
+    rows = [{"ts": "2022-04-20T01:02:03", "g": "g1", "v": 7},
+            {"ts": "2022-05-02T00:00:00", "g": "g5", "v": 10}]
+    out = eng.append("t", rows)
+    assert out["rows"] == 2 and out["delta_rows"] == 2
+    ts = eng.catalog.get("t").segments
+    assert ts.delta_ids() and ts.sealed_generation < ts.generation
+    _assert_parity(eng, _reference(rows), "append")
+
+
+def test_append_new_dict_values_and_lex_bounds():
+    """Unseen string values take tail codes (dictionary temporarily
+    unsorted): lexicographic bound filters must stay exact via the
+    predicate-table fallback, and GROUP BY ordering must stay
+    value-ordered."""
+    eng = _engine()
+    rows = [{"ts": "2022-04-20", "g": "aardvark", "v": 1},
+            {"ts": "2022-04-21", "g": "zzz", "v": 2},
+            {"ts": "2022-04-22", "g": "g3", "v": 3}]
+    eng.append("t", rows)
+    assert not eng.catalog.get("t").segments.dictionaries["g"].is_sorted
+    ref = _reference(rows)
+    for q in ["SELECT count(*) AS n FROM t WHERE g >= 'g5' AND g < 'z'",
+              "SELECT count(*) AS n FROM t WHERE g BETWEEN 'a' AND 'b'",
+              "SELECT g, count(*) AS n FROM t WHERE g > 'g6' "
+              "GROUP BY g ORDER BY g",
+              "SELECT count(*) AS n FROM t WHERE g LIKE 'g%'"]:
+        assert _digest(eng.sql(q)) == _digest(ref.sql(q)), q
+    _assert_parity(eng, ref, "new-dict")
+
+
+def test_append_validation_never_half_applied():
+    eng = _engine()
+    before = eng.catalog.get("t").segments
+    with pytest.raises(UserError):
+        eng.append("t", [{"ts": "2022-04-20", "nope": 1}])
+    with pytest.raises(UserError):  # LONG column, junk value
+        eng.append("t", [{"ts": "2022-04-20", "g": "g1", "v": "x"}])
+    with pytest.raises(UserError):  # non-null time required
+        eng.append("t", [{"g": "g1", "v": 1}])
+    after = eng.catalog.get("t").segments
+    assert after is before and after.delta_rows == 0
+    # unaccelerated tables refuse legibly
+    eng.register_table("plain", pd.DataFrame({"x": [1]}),
+                       accelerate=False)
+    with pytest.raises(UserError):
+        eng.append("plain", [{"x": 2}])
+
+
+def test_append_nulls_and_numeric_widening():
+    eng = _engine()
+    rows = [{"ts": "2022-04-20", "g": None, "v": None},
+            {"ts": "2022-04-21", "g": "g1", "v": 1_000_000}]
+    eng.append("t", rows)  # v widens past the sealed int16 range
+    got = eng.sql("SELECT count(*) AS n, sum(v) AS s, "
+                  "count(v) AS nv FROM t")
+    assert int(got["n"][0]) == 2002
+    assert int(got["nv"][0]) == 2001  # the NULL v doesn't count
+    assert int(got["s"][0]) == 999008 + 1_000_000
+
+
+def test_insert_into_sql_verb():
+    eng = _engine()
+    out = eng.sql("INSERT INTO t (ts, g, v) VALUES "
+                  "(TIMESTAMP '2022-04-20 01:02:03', 'g1', 7), "
+                  "('2022-05-02', 'it''s', NULL)")
+    assert int(out["rows"][0]) == 2 and int(out["delta_rows"][0]) == 2
+    got = eng.sql("SELECT count(*) AS n FROM t WHERE g = 'it''s'")
+    assert int(got["n"][0]) == 1
+    with pytest.raises(UserError):
+        eng.sql("INSERT INTO t (ts, g) VALUES (1, 'a', 3)")
+
+
+def test_fallback_path_sees_delta():
+    eng = _engine()
+    rows = [{"ts": "2022-04-20", "g": "g1", "v": 7}]
+    eng.append("t", rows)
+    # force the interpreter: fallback frames must include the delta
+    from tpu_olap.planner.fallback import execute_fallback
+    from tpu_olap.planner.sqlparse import parse_sql
+    got = execute_fallback(
+        parse_sql("SELECT count(*) AS n, sum(v) AS s FROM t"),
+        eng.catalog, eng.config)
+    assert int(got["n"][0]) == 2001
+    assert int(got["s"][0]) == 999008 + 7
+
+
+# ---------------------------------------------------- WAL / recovery
+
+def test_wal_replay_restores_acknowledged_state(tmp_path):
+    wal = str(tmp_path)
+    eng = _engine(ingest_wal_dir=wal)
+    acked = []
+    for i in range(5):
+        rows = [{"ts": f"2022-05-{10 + i:02d}", "g": f"w{i}",
+                 "v": i * 100}]
+        out = eng.append("t", rows)
+        assert out["wal_seq"] == i + 1
+        acked.extend(rows)
+    digests = {q: _digest(eng.sql(q)) for q in PARITY_QUERIES}
+    # crash: abandon the engine; a fresh process registers the same
+    # base and the WAL replays to the exact acknowledged state
+    rec = _engine(ingest_wal_dir=wal)
+    assert rec.catalog.get("t").segments.delta_rows == 5
+    for q in PARITY_QUERIES:
+        assert _digest(rec.sql(q)) == digests[q], q
+    ev = [e for e in rec.runner.events.snapshot()
+          if e["event"] == "wal_replay"]
+    assert ev and ev[0]["records"] == 5 and ev[0]["rows"] == 5
+    # the replayed engine keeps appending with continuous seqs
+    assert rec.append("t", acked[:1])["wal_seq"] == 6
+
+
+def test_wal_torn_tail_truncates(tmp_path):
+    wal = str(tmp_path)
+    eng = _engine(ingest_wal_dir=wal)
+    eng.append("t", [{"ts": "2022-05-10", "g": "w", "v": 1}])
+    want = _digest(eng.sql(PARITY_QUERIES[0]))
+    path = os.path.join(wal, "t.wal")
+    good = os.path.getsize(path)
+    with open(path, "ab") as f:
+        f.write(b"\x40\x00\x00\x00torn-frame-garbage")
+    rec = _engine(ingest_wal_dir=wal)
+    assert _digest(rec.sql(PARITY_QUERIES[0])) == want
+    assert os.path.getsize(path) == good  # tail cut off
+
+
+def test_reregistering_live_table_resets_wal(tmp_path):
+    wal = str(tmp_path)
+    eng = _engine(ingest_wal_dir=wal)
+    eng.append("t", [{"ts": "2022-05-10", "g": "w", "v": 1}])
+    assert os.path.getsize(os.path.join(wal, "t.wal")) > 0
+    # fresh data replaces the table IN-PROCESS: the logged appends
+    # belonged to the old data — no replay, log truncated
+    eng.register_table("t", _df(seed=9), time_column="ts",
+                       block_rows=BLOCK)
+    assert eng.catalog.get("t").segments.delta_rows == 0
+    assert os.path.getsize(os.path.join(wal, "t.wal")) == 0
+
+
+def test_drop_table_deletes_wal(tmp_path):
+    wal = str(tmp_path)
+    eng = _engine(ingest_wal_dir=wal)
+    eng.append("t", [{"ts": "2022-05-10", "g": "w", "v": 1}])
+    eng.drop_table("t")
+    assert not os.path.exists(os.path.join(wal, "t.wal"))
+
+
+# ------------------------------------------- backpressure / compaction
+
+def test_backpressure_sheds_never_drops():
+    eng = _engine(ingest_max_delta_rows=8)
+    ok = eng.append("t", [{"ts": "2022-05-01", "g": "a", "v": 1}] * 8)
+    assert ok["delta_rows"] == 8
+    with pytest.raises(IngestBackpressure) as ei:
+        eng.append("t", [{"ts": "2022-05-01", "g": "a", "v": 1}])
+    assert ei.value.http_status == 429
+    assert ei.value.retry_after_s > 0
+    # shed means SHED: the rejected row is absent, the 8 accepted stay
+    assert eng.catalog.get("t").segments.delta_rows == 8
+    assert int(eng.sql("SELECT count(*) AS n FROM t")["n"][0]) == 2008
+    # compaction drains the delta; the retried append then lands
+    eng.compact_now("t")
+    assert eng.append("t", [{"ts": "2022-05-01", "g": "a",
+                             "v": 1}])["rows"] == 1
+
+
+def test_compaction_seals_resorts_and_preserves_results():
+    eng = _engine()
+    rows = [{"ts": "2022-04-20", "g": "zzz", "v": 5},
+            {"ts": "2022-02-01", "g": "aaa", "v": 6}]
+    eng.append("t", rows)
+    ref = _reference(rows)
+    ts0 = eng.catalog.get("t").segments
+    res = eng.compact_now("t")
+    assert res["delta_rows_folded"] == 2
+    ts1 = eng.catalog.get("t").segments
+    assert ts1.delta_rows == 0 and ts1.sealed_count == len(ts1.segments)
+    assert ts1.sealed_generation > ts0.sealed_generation
+    assert ts1.dictionaries["g"].is_sorted  # tail re-sorted
+    # sealed blocks are time-ordered again (id order tracks time_min)
+    mins = [s.meta.time_min for s in ts1.segments]
+    assert mins == sorted(mins)
+    _assert_parity(eng, ref, "post-compact")
+    # SQL spelling
+    out = eng.sql("COMPACT DRUID TABLE t")
+    assert out["status"][0] == "empty-delta"
+
+
+def test_compaction_keeps_racing_appends():
+    eng = _engine()
+    stop = threading.Event()
+    appended = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            rows = [{"ts": "2022-04-25", "g": f"r{i % 4}", "v": i}]
+            eng.append("t", rows)
+            appended.extend(rows)
+            i += 1
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        time.sleep(0.05)
+        for _ in range(3):
+            eng.compact_now("t")
+    finally:
+        stop.set()
+        t.join()
+    eng.compact_now("t")
+    _assert_parity(eng, _reference(appended), "racing-appends")
+
+
+def test_background_compactor_and_close_joins_threads():
+    eng = _engine(ingest_auto_compact=True, ingest_compact_rows=4,
+                  ingest_compact_interval_s=0.05)
+    rows = [{"ts": "2022-04-25", "g": "bg", "v": 1}] * 6
+    eng.append("t", rows)
+    deadline = time.monotonic() + 10
+    while eng.catalog.get("t").segments.delta_rows and \
+            time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert eng.catalog.get("t").segments.delta_rows == 0
+    _assert_parity(eng, _reference(rows), "bg-compact")
+    # deterministic shutdown: compactor joined, maintainer joined
+    compactor = eng.ingest._compactor
+    assert compactor is not None
+    eng.close()
+    assert eng.ingest._compactor is None
+    assert not compactor.is_alive()
+    m = eng.cubes._maintainer
+    assert m is None or not m.is_alive()
+    # the engine stays usable after close
+    assert int(eng.sql("SELECT count(*) AS n FROM t")["n"][0]) == 2006
+
+
+# ------------------------------------------------- partial survival
+
+def test_delta_append_preserves_tier1_partials():
+    eng = _engine(data=_df(4000), segment_cache_enabled=True,
+                  result_cache_enabled=True)
+    q = PARITY_QUERIES[0]
+    eng.sql(q)                       # populate tier 1 + tier 2
+    rec = eng.runner.history[-1]
+    n_sealed_cached = rec["segments_computed"]
+    eng.sql(q)                       # tier-2 hit
+    assert eng.runner.history[-1].get("cache_tier") == "full"
+    stats0 = dict(eng.runner.result_cache.stats["segment"])
+    eng.append("t", [{"ts": "2022-05-01", "g": "g3", "v": 3}])
+    out = eng.sql(q)                 # tier-2 stale; tier-1 survives
+    rec = eng.runner.history[-1]
+    stats1 = dict(eng.runner.result_cache.stats["segment"])
+    assert rec.get("cache_tier") == "segment"
+    assert rec["segments_cached"] > 0, "sealed partials were evicted"
+    assert stats1["hit"] - stats0["hit"] == rec["segments_cached"]
+    # only straddlers + the delta block recomputed, not the store
+    assert rec["segments_computed"] < n_sealed_cached
+    ref = _reference([{"ts": "2022-05-01", "g": "g3", "v": 3}],
+                     n=4000)
+    assert _digest(out) == _digest(ref.sql(q))
+
+
+def test_delta_append_keeps_cube_current_zero_stale():
+    eng = _engine(data=_df(4000), cube_serve_min_reduction=0.0)
+    eng.sql("CREATE DRUID CUBE c1 ON t DIMENSIONS (g) "
+            "GRANULARITY month AGGREGATES (sum(v), count(*))")
+    q = PARITY_QUERIES[0]
+    eng.sql(q)
+    assert eng.runner.history[-1].get("cube") == "c1"
+    rows = [{"ts": "2022-05-01", "g": "g3", "v": 3},
+            {"ts": "2022-03-05", "g": "new_val", "v": 11}]
+    eng.append("t", rows)
+    cube = eng.cubes.get("c1")
+    assert not cube.snapshot_row(eng)["stale"], \
+        "delta-only append must not stale the cube"
+    out = eng.sql(q)
+    rec = eng.runner.history[-1]
+    assert rec.get("cube") == "c1" and rec.get("delta_segments") == 1
+    ref = _reference(rows, n=4000)
+    assert _digest(out) == _digest(ref.sql(q))  # zero stale serves
+    assert cube.refreshes == 0  # no full rebuild for the open bucket
+    # compaction changes the SEALED set: now the cube is stale until
+    # the maintainer/REFRESH rebuilds it — and never served meanwhile
+    eng.compact_now("t")
+    assert cube.snapshot_row(eng)["stale"]
+    out = eng.sql(q)
+    assert eng.runner.history[-1].get("cube") is None
+    assert _digest(out) == _digest(ref.sql(q))
+    eng.sql("REFRESH DRUID CUBES")
+    out = eng.sql(q)
+    assert eng.runner.history[-1].get("cube") == "c1"
+    assert _digest(out) == _digest(ref.sql(q))
+
+
+# -------------------------------------------------- surfaces / obs
+
+def test_sys_segments_kind_watermark_and_debug_ingest():
+    eng = _engine()
+    eng.append("t", [{"ts": "2022-05-01", "g": "g1", "v": 1}])
+    segs = eng.sql("SELECT * FROM sys.segments WHERE table = 't'")
+    kinds = set(segs["kind"])
+    assert kinds == {"sealed", "delta"}
+    wm = eng.catalog.get("t").segments.watermark
+    assert (segs["watermark"] == wm).all()
+    delta = segs[segs["kind"] == "delta"]
+    assert int(delta["rows"].sum()) == 1
+    snap = eng.ingest.snapshot()
+    ti = snap["tables"]["t"]
+    assert ti["delta_rows"] == 1 and ti["watermark"] == wm
+    assert ti["appended_rows"] == 1
+    # metrics families
+    text = eng.metrics.render()
+    for fam in ("tpu_olap_ingest_rows_total",
+                "tpu_olap_delta_rows"):
+        assert fam in text, fam
+    ev = [e for e in eng.runner.events.snapshot()
+          if e["event"] == "ingest" and e.get("kind") == "append"]
+    assert ev and ev[0]["rows"] == 1
+
+
+def test_http_ingest_endpoints(tmp_path):
+    import json
+    import urllib.request
+
+    from tpu_olap.api.server import QueryServer
+    eng = _engine(ingest_wal_dir=str(tmp_path),
+                  ingest_max_delta_rows=4)
+    srv = QueryServer(eng).start()
+    try:
+        def post(path, payload):
+            req = urllib.request.Request(
+                srv.url + path, json.dumps(payload).encode(),
+                {"Content-Type": "application/json"})
+            return urllib.request.urlopen(req)
+
+        r = post("/ingest", {"table": "t", "rows": [
+            {"ts": "2022-05-01", "g": "g1", "v": 5}]})
+        body = json.loads(r.read())
+        assert r.status == 200 and body["rows"] == 1
+        assert body["wal_seq"] == 1
+        # visible through SQL over HTTP
+        r = post("/sql", {"query": "SELECT count(*) AS n FROM t"})
+        assert json.loads(r.read())["rows"][0]["n"] == 2001
+        # backpressure: full delta -> 429 + Retry-After, body says why
+        post("/ingest", {"table": "t", "rows": [
+            {"ts": "2022-05-01", "g": "g1", "v": 5}] * 3})
+        try:
+            post("/ingest", {"table": "t", "rows": [
+                {"ts": "2022-05-01", "g": "g1", "v": 5}]})
+            raise AssertionError("expected 429")
+        except urllib.error.HTTPError as e:
+            assert e.code == 429
+            assert int(e.headers["Retry-After"]) >= 1
+            assert json.loads(e.read())["code"] == \
+                "ingest_backpressure"
+        with urllib.request.urlopen(srv.url + "/debug/ingest") as r:
+            snap = json.loads(r.read())
+        assert snap["tables"]["t"]["delta_rows"] == 4
+        assert snap["tables"]["t"]["wal"]["bytes"] > 0
+    finally:
+        srv.stop()
+    # Server.stop() called Engine.close(): background threads joined
+    assert eng.ingest._compactor is None \
+        or not eng.ingest._compactor.is_alive()
+
+
+# ------------------------------------------------------ chaos suite
+
+CHAOS_SITES = ("append", "wal-write", "compact", "wal-replay")
+
+
+def _chaos_round(seed, wal_dir, n_ops=40):
+    """One kill-and-recover round: appends ∥ queries ∥ compactions
+    under seeded faults at the ingest sites, then a simulated crash and
+    WAL replay into a fresh engine. Returns (recovered, acked rows)."""
+    eng = _engine(ingest_wal_dir=wal_dir)
+    inj = FaultInjector(seed=seed, rate=0.2,
+                        stages={"append", "wal-write", "compact"})
+    eng.config.fault_injector = inj
+    rng = np.random.default_rng(seed)
+    acked = []
+    q = PARITY_QUERIES[0]
+    for i in range(n_ops):
+        op = rng.integers(0, 10)
+        if op < 6:
+            rows = [{"ts": "2022-04-25", "g": f"c{int(rng.integers(4))}",
+                     "v": int(rng.integers(100))}]
+            try:
+                acked_out = eng.append("t", rows)
+                acked.extend(rows)
+                assert acked_out["rows"] == 1
+            except RuntimeError:
+                pass  # injected before any state change
+        elif op < 8:
+            # queries stay exact mid-chaos (the delta is a snapshot)
+            got = eng.sql(q)
+            assert int(got["n"].sum()) == 2000 + len(acked)
+        else:
+            try:
+                eng.compact_now("t")
+            except RuntimeError:
+                pass  # injected: delta intact, retried later
+    eng.config.fault_injector = None
+    # the live engine never lost an acknowledged row
+    assert int(eng.sql(q)["n"].sum()) == 2000 + len(acked)
+    # crash + recover (wal-replay faults: first attempt may die —
+    # the table must come back base-only, and a retry replays fully)
+    rec = Engine(_cfg(ingest_wal_dir=wal_dir))
+    rinj = FaultInjector(seed=seed + 1, rate=0.3,
+                         stages={"wal-replay"})
+    rec.config.fault_injector = rinj
+    try:
+        rec.register_table("t", _df(), time_column="ts",
+                           block_rows=BLOCK)
+    except RuntimeError:
+        assert int(rec.sql(q)["n"].sum()) == 2000  # cleanly base-only
+        rec.config.fault_injector = None
+        rec.register_table("t", _df(), time_column="ts",
+                           block_rows=BLOCK)
+    rec.config.fault_injector = None
+    return rec, acked, inj
+
+
+@pytest.mark.parametrize("seed", [7, 19])
+def test_chaos_kill_and_recover_parity(seed, tmp_path):
+    rec, acked, inj = _chaos_round(seed, str(tmp_path / f"w{seed}"))
+    assert inj.faults > 0, "chaos never fired — the test proves nothing"
+    ref = _reference(acked)
+    _assert_parity(rec, ref, f"chaos seed {seed}")
+    # recovery is idempotent across another crash + compaction
+    rec.compact_now("t")
+    _assert_parity(rec, ref, f"chaos seed {seed} post-compact")
+
+
+def test_chaos_concurrent_append_query_compact(tmp_path):
+    """append ∥ query ∥ compact on real threads with seeded faults;
+    then crash → replay → sha256 parity vs a one-shot registration of
+    base + acknowledged appends."""
+    wal = str(tmp_path / "wc")
+    eng = _engine(ingest_wal_dir=wal)
+    inj = FaultInjector(seed=11, rate=0.1,
+                        stages={"append", "wal-write", "compact"})
+    eng.config.fault_injector = inj
+    acked = []
+    alock = threading.Lock()
+    stop = threading.Event()
+    errors = []
+
+    def writer(wid):
+        i = 0
+        while not stop.is_set():
+            rows = [{"ts": "2022-04-25", "g": f"w{wid}",
+                     "v": wid * 1000 + i}]
+            try:
+                eng.append("t", rows)
+                with alock:
+                    acked.extend(rows)
+            except RuntimeError:
+                pass
+            i += 1
+
+    def reader():
+        while not stop.is_set():
+            try:
+                got = eng.sql(PARITY_QUERIES[0])
+                n = int(got["n"].sum())
+                with alock:
+                    lo = 2000  # acked grows monotonically
+                if n < lo:
+                    errors.append(f"lost rows: {n}")
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+    threads = [threading.Thread(target=writer, args=(w,))
+               for w in range(2)] + [threading.Thread(target=reader)]
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            try:
+                eng.compact_now("t")
+            except RuntimeError:
+                pass
+            time.sleep(0.05)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errors, errors[:3]
+    assert int(eng.sql(PARITY_QUERIES[0])["n"].sum()) \
+        == 2000 + len(acked)
+    eng.config.fault_injector = None
+    eng.close()
+    # crash + replay
+    rec = _engine(ingest_wal_dir=wal)
+    _assert_parity(rec, _reference(acked), "concurrent chaos")
+
+
+# ------------------------------------------- durability edge hardening
+
+def test_wal_failed_write_rolls_back_and_never_replays(tmp_path,
+                                                       monkeypatch):
+    """A write acknowledged to NOBODY must not survive into recovery:
+    an fsync failure rolls the file back to the last acked frame and
+    the failed batch's seq slot is never reused."""
+    wal = str(tmp_path)
+    eng = _engine(ingest_wal_dir=wal)
+    eng.append("t", [{"ts": "2022-04-01", "g": "g1", "v": 1}])
+    path = os.path.join(wal, "t.wal")
+    size_acked = os.path.getsize(path)
+
+    real_fsync = os.fsync
+    boom = {"on": True}
+
+    def flaky_fsync(fd):
+        if boom["on"]:
+            raise OSError(28, "No space left on device")
+        return real_fsync(fd)
+
+    monkeypatch.setattr(os, "fsync", flaky_fsync)
+    with pytest.raises(OSError):
+        eng.append("t", [{"ts": "2022-04-02", "g": "g2", "v": 123456}])
+    boom["on"] = False
+    # rolled back: no unacknowledged frame left behind
+    assert os.path.getsize(path) == size_acked
+    # the failed batch never reached the delta either
+    assert int(eng.sql(
+        "SELECT count(*) AS n FROM t WHERE v = 123456")["n"].iloc[0]) == 0
+    # next append acks normally and recovery sees exactly the acks
+    eng.append("t", [{"ts": "2022-04-03", "g": "g3", "v": 3}])
+    eng.close()
+    monkeypatch.undo()
+    rec = _engine(ingest_wal_dir=wal)
+    _assert_parity(rec, _reference(
+        [{"ts": "2022-04-01", "g": "g1", "v": 1},
+         {"ts": "2022-04-03", "g": "g3", "v": 3}]), "post-rollback")
+    rec.close()
+
+
+def test_wal_replay_stops_at_seq_regression(tmp_path):
+    """Defense in depth: a frame whose seq does not advance (a rolled-
+    back write that survived anyway) truncates replay like a torn
+    tail — only the strictly-increasing acked prefix applies."""
+    import json
+    import struct
+    import zlib
+
+    from tpu_olap.segments.wal import replay_wal
+    path = str(tmp_path / "t.wal")
+    with open(path, "wb") as f:
+        for seq, v in [(1, 10), (2, 20), (2, 99), (3, 30)]:
+            payload = json.dumps(
+                {"seq": seq,
+                 "rows": [{"__time": 1648771200000, "g": "g1",
+                           "v": v}]},
+                separators=(",", ":")).encode()
+            f.write(struct.pack("<II", len(payload),
+                                zlib.crc32(payload)) + payload)
+    records = replay_wal(path)
+    assert [s for s, _ in records] == [1, 2]
+    assert [r[0]["v"] for _, r in records] == [10, 20]
+
+
+def test_register_after_close_resets_wal(tmp_path):
+    """Engine.close() closes every WAL; re-registering the table
+    afterwards must still reset the log instead of raising (the engine
+    stays usable after close)."""
+    wal = str(tmp_path)
+    eng = _engine(ingest_wal_dir=wal)
+    eng.append("t", [{"ts": "2022-04-01", "g": "g1", "v": 1}])
+    eng.close()
+    eng.register_table("t", _df(), time_column="ts", block_rows=BLOCK)
+    # the logged append belonged to the replaced data: log is gone
+    rec = _engine(ingest_wal_dir=wal)
+    _assert_parity(rec, _reference([]), "post-close re-register")
+    rec.close()
+    eng.close()
+
+
+def test_compact_skip_statuses_are_distinguishable():
+    """COMPACT DRUID TABLE must not claim 'empty-delta' when the
+    compaction was actually skipped (breaker open / already running)."""
+    eng = _engine()
+    eng.append("t", [{"ts": "2022-04-01", "g": "g1", "v": 1}])
+    br = eng.runner.breaker
+    for _ in range(int(eng.config.breaker_failure_threshold or 3)):
+        br.record_failure()
+    assert br.state == "open"
+    res = eng.compact_now("t")
+    assert res["status"] == "breaker-open"
+    out = eng.sql("COMPACT DRUID TABLE t")
+    assert out["status"].iloc[0] == "breaker-open"
+    br.close()
+    res = eng.compact_now("t")
+    assert res["status"] == "compacted" and res["delta_rows_folded"] == 1
+    assert eng.compact_now("t") is None  # genuinely empty now
+    out = eng.sql("COMPACT DRUID TABLE t")
+    assert out["status"].iloc[0] == "empty-delta"
+
+
+def test_compaction_consolidates_fallback_frames():
+    """Per-append fallback frames must not accumulate across
+    compactions: sealed appends consolidate to one frame."""
+    eng = _engine()
+    for i in range(6):
+        eng.append("t", [{"ts": "2022-04-01", "g": "g1", "v": i}])
+    st = eng.ingest._state("t")
+    assert len(st.frames) == 6
+    eng.compact_now("t")
+    assert len(st.frames) <= 1
+    eng.append("t", [{"ts": "2022-04-02", "g": "g2", "v": 50}])
+    eng.compact_now("t")
+    assert len(st.frames) <= 1
+    # every appended row still visible exactly once
+    rows = [{"ts": "2022-04-01", "g": "g1", "v": i} for i in range(6)]
+    rows.append({"ts": "2022-04-02", "g": "g2", "v": 50})
+    _assert_parity(eng, _reference(rows), "consolidated frames")
+
+
+def test_empty_append_returns_full_shape():
+    eng = _engine()
+    out = eng.append("t", [])
+    assert {"table", "rows", "generation", "sealed_generation",
+            "delta_rows", "watermark", "wal_seq"} <= set(out)
+    assert out["rows"] == 0
+
+
+def test_append_out_of_bounds_time_rejected_atomically(tmp_path):
+    """The fallback frame is built BEFORE the WAL write: a timestamp
+    the encoder accepts but pandas cannot represent must reject the
+    whole batch with nothing applied — not ack a batch the
+    interpreter path can never see."""
+    wal = str(tmp_path)
+    eng = _engine(ingest_wal_dir=wal)
+    path = os.path.join(wal, "t.wal")
+    size0 = os.path.getsize(path) if os.path.exists(path) else 0
+    with pytest.raises(Exception):
+        eng.append("t", [{"ts": 10**16, "g": "g1", "v": 123456}])
+    assert (os.path.getsize(path) if os.path.exists(path)
+            else 0) == size0
+    assert eng.catalog.get("t").segments.delta_rows == 0
+    assert int(eng.sql(
+        "SELECT count(*) AS n FROM t WHERE v = 123456")["n"].iloc[0]) == 0
+    eng.close()
+    rec = _engine(ingest_wal_dir=wal)
+    _assert_parity(rec, _reference([]), "oob-time reject")
+    rec.close()

@@ -1,0 +1,161 @@
+"""Real-time ingest smoke (ISSUE 13 satellite; the `ingest-smoke` CI
+job in .github/workflows/tier1.yml).
+
+End-to-end crash-recovery contract, seconds-scale:
+
+1. a CHILD process registers a deterministic base table with a WAL
+   directory, appends batches (each acknowledged only after the WAL
+   frame is durable), proves the rows are visible in the same process,
+   reports the acknowledged count on stdout, then SIGKILLs itself —
+   no atexit, no flush, a real crash;
+2. the parent starts a fresh engine over the same WAL directory,
+   registers the same base, and the WAL replays to the exact
+   acknowledged state;
+3. query results must be sha256-identical to a one-shot
+   `register_table` of base + acknowledged rows (never-lost /
+   never-half-applied), before AND after compaction seals the delta.
+
+Exit 0 on success, 1 on any violation.
+"""
+
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+N_BASE = 2000
+N_BATCHES = 7
+ROWS_PER_BATCH = 3
+BLOCK = 512
+
+QUERIES = [
+    "SELECT g, count(*) AS n, sum(v) AS s FROM t GROUP BY g ORDER BY g",
+    "SELECT month(ts) AS mo, sum(v) AS s, min(v) AS lo FROM t "
+    "GROUP BY month(ts) ORDER BY mo",
+    "SELECT count(*) AS n, sum(v) AS s FROM t WHERE v < 500",
+]
+
+
+def base_frame():
+    import numpy as np
+    import pandas as pd
+    rng = np.random.default_rng(42)
+    return pd.DataFrame({
+        "ts": pd.to_datetime("2022-03-01")
+        + pd.to_timedelta(rng.integers(0, 86400 * 45, N_BASE),
+                          unit="s"),
+        "g": rng.choice([f"g{i}" for i in range(8)], N_BASE),
+        "v": rng.integers(0, 1000, N_BASE).astype(np.int64),
+    })
+
+
+def batch(i):
+    return [{"ts": f"2022-05-{10 + i:02d}T00:00:0{j}",
+             "g": f"s{i % 3}", "v": i * 10 + j}
+            for j in range(ROWS_PER_BATCH)]
+
+
+def digest(frame):
+    return hashlib.sha256(frame.to_csv(index=False).encode()) \
+        .hexdigest()
+
+
+def make_engine(wal_dir):
+    from tpu_olap import Engine
+    from tpu_olap.executor import EngineConfig
+    eng = Engine(EngineConfig(ingest_wal_dir=wal_dir,
+                              ingest_auto_compact=False))
+    eng.register_table("t", base_frame(), time_column="ts",
+                       block_rows=BLOCK)
+    return eng
+
+
+def child_main(wal_dir):
+    eng = make_engine(wal_dir)
+    acked = 0
+    for i in range(N_BATCHES):
+        out = eng.append("t", batch(i))
+        assert out["wal_seq"] == i + 1
+        acked += out["rows"]
+    # rows are visible in the SAME process, pre-crash
+    n = int(eng.sql("SELECT count(*) AS n FROM t")["n"][0])
+    assert n == N_BASE + acked, f"visibility: {n}"
+    print(json.dumps({"acked_batches": N_BATCHES,
+                      "acked_rows": acked, "visible": n}), flush=True)
+    os.kill(os.getpid(), signal.SIGKILL)  # the real thing
+
+
+def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child_main(sys.argv[2])
+        return 1  # unreachable
+
+    wal_dir = tempfile.mkdtemp(prefix="ingest-smoke-wal-")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child",
+         wal_dir], capture_output=True, text=True, env=env,
+        timeout=300)
+    if proc.returncode != -signal.SIGKILL:
+        print(f"FAIL: child exited {proc.returncode}, expected "
+              f"SIGKILL\nstdout: {proc.stdout}\nstderr: {proc.stderr}")
+        return 1
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    acked = report["acked_rows"]
+    print(f"child: acked {acked} rows over "
+          f"{report['acked_batches']} batches, then SIGKILL")
+
+    # --- recovery: fresh engine + same base -> WAL replay
+    eng = make_engine(wal_dir)
+    delta = eng.catalog.get("t").segments.delta_rows
+    if delta != acked:
+        print(f"FAIL: replay restored {delta} rows, acked {acked}")
+        return 1
+    replay_ev = [e for e in eng.runner.events.snapshot()
+                 if e["event"] == "wal_replay"]
+    if not replay_ev:
+        print("FAIL: no wal_replay event")
+        return 1
+    print(f"replay: {replay_ev[0]['records']} records, "
+          f"{replay_ev[0]['rows']} rows in {replay_ev[0]['ms']} ms")
+
+    # --- sha256 parity vs one-shot registration of the same rows
+    import pandas as pd
+    from tpu_olap import Engine
+    extra = [r for i in range(N_BATCHES) for r in batch(i)]
+    ext = pd.DataFrame(extra)
+    ext["ts"] = pd.to_datetime(ext["ts"])
+    ref = Engine()
+    ref.register_table("t", pd.concat([base_frame(), ext],
+                                      ignore_index=True),
+                       time_column="ts", block_rows=BLOCK)
+    for q in QUERIES:
+        if digest(eng.sql(q)) != digest(ref.sql(q)):
+            print(f"FAIL: post-replay parity: {q}")
+            return 1
+    print("post-replay parity: OK")
+
+    # --- compaction seals the delta; results must not move
+    res = eng.compact_now("t")
+    if res is None or eng.catalog.get("t").segments.delta_rows != 0:
+        print("FAIL: compaction did not seal the delta")
+        return 1
+    for q in QUERIES:
+        if digest(eng.sql(q)) != digest(ref.sql(q)):
+            print(f"FAIL: post-compaction parity: {q}")
+            return 1
+    print(f"compaction: sealed {res['rows_sealed']} rows in "
+          f"{res['ms']:.0f} ms; post-compaction parity: OK")
+    eng.close()
+    print("ingest smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -109,6 +109,13 @@ class Engine:
         # from them, the background maintainer rebuilds stale ones
         from tpu_olap.cubes import CubeRegistry
         self.cubes = CubeRegistry(self)
+        # real-time ingest (segments/delta.py; docs/INGEST.md):
+        # Engine.append / POST /ingest / INSERT INTO land rows in a
+        # WAL-backed mutable delta scope, queryable immediately; a
+        # background compactor seals deltas into time-partitioned
+        # segments under the admission/breaker machinery
+        from tpu_olap.segments.delta import IngestManager
+        self.ingest = IngestManager(self)
 
     # ------------------------------------------------------- registration
 
@@ -226,11 +233,51 @@ class Engine:
             rows=segments.num_rows if segments is not None else None,
             segments=len(segments.segments) if segments is not None
             else 0)
+        # real-time ingest hook (docs/INGEST.md): a first registration
+        # with an existing WAL is crash recovery — replay appends to
+        # the exact acknowledged state; re-registering a live table
+        # resets its log instead (the appends belonged to the old data)
+        self.ingest.on_register(entry)
         # cube cascade (docs/CUBES.md): rollups over this table are now
         # stale — the rewrite pass stops serving them at generation-
         # check time; the maintainer wakes to rebuild
         self.cubes.on_table_registered(name)
         return entry
+
+    def append(self, table: str, rows) -> dict:
+        """Real-time append (docs/INGEST.md): `rows` (list of dicts or
+        a DataFrame, columns ⊆ the table's schema, time under the
+        registered time column or ``__time``) land in the table's
+        mutable in-memory delta and are queryable immediately alongside
+        sealed segments — same kernels, same caches, exact results.
+        With `ingest_wal_dir` set the batch is framed into the table's
+        write-ahead log BEFORE acknowledgment, so a crash replays to
+        the exact acknowledged state at the next registration. A delta
+        at `ingest_max_delta_rows` sheds with IngestBackpressure (HTTP
+        429 + Retry-After) — never a silent drop. SQL spelling:
+        ``INSERT INTO t (cols) VALUES (...)``; HTTP: ``POST /ingest``.
+
+        Returns {table, rows, generation, sealed_generation,
+        delta_rows, watermark, wal_seq}."""
+        return self.ingest.append(table, rows)
+
+    def compact_now(self, table: str | None = None):
+        """Synchronously seal delta rows into time-partitioned sealed
+        segments (the background compactor's deterministic spelling).
+        `table=None` compacts every table with a non-empty delta."""
+        if table is None:
+            return self.ingest.compact_all()
+        return self.ingest.compact_now(table)
+
+    def close(self):
+        """Deterministically stop and JOIN every background thread the
+        engine owns — the compactor and WAL flushers (ingest.stop) and
+        the cube maintainer — and flush the event sink. The engine
+        stays queryable afterwards; appends reopen WALs lazily and
+        restart the compactor on demand. Server.stop() calls this."""
+        self.ingest.stop()
+        self.cubes.stop(join=True)
+        self.runner.events.flush(2.0)
 
     def register_lookup(self, name: str, mapping: dict):
         """Register a named lookup map (Druid lookup extraction fn). SQL
@@ -799,6 +846,9 @@ class Engine:
         with self.device_lock:
             self.runner.clear_cache(name)
         self.catalog.drop(name)
+        # ingest cascade: delta state dies with the table and its WAL
+        # is deleted (a later re-registration starts a fresh log)
+        self.ingest.on_drop(name)
         # cube cascade: rollups over a dropped base are dropped too
         # (their storage tables unregister with them)
         self.cubes.on_table_dropped(name)
@@ -865,6 +915,14 @@ _DROP_CUBE_RE = _re.compile(
     r"^\s*drop\s+druid\s+cube\s+(\w+)\s*;?\s*$", _re.I)
 _REFRESH_CUBES_RE = _re.compile(
     r"^\s*refresh\s+druid\s+cubes\s*;?\s*$", _re.I)
+# real-time ingest verbs (docs/INGEST.md): INSERT INTO t (a, b) VALUES
+# (...), (...); COMPACT DRUID TABLE t — the SQL spellings of
+# Engine.append / Engine.compact_now
+_INSERT_RE = _re.compile(
+    r"^\s*insert\s+into\s+(\w+)\s*\(([^)]*)\)\s*values\s*(.+?)\s*;?\s*$",
+    _re.I | _re.S)
+_COMPACT_RE = _re.compile(
+    r"^\s*compact\s+druid\s+table\s+(\w+)\s*;?\s*$", _re.I)
 # cheap pre-parse hint that a statement MIGHT reference a sys.* virtual
 # datasource (catalog.systables): a match still confirms against the
 # parsed tree before taking the introspection path
@@ -909,6 +967,14 @@ def _match_verb(query: str):
         return lambda eng: _run_drop_cube(eng, name)
     if _REFRESH_CUBES_RE.match(query):
         return _run_refresh_cubes
+    m = _INSERT_RE.match(query)
+    if m:
+        table, cols, values = m.group(1), m.group(2), m.group(3)
+        return lambda eng: _run_insert(eng, table, cols, values)
+    m = _COMPACT_RE.match(query)
+    if m:
+        table = m.group(1)
+        return lambda eng: _run_compact(eng, table)
     return None
 
 
@@ -1071,6 +1137,87 @@ def _run_refresh_cubes(eng: Engine) -> pd.DataFrame:
         {"cube": n, "status": "ok" if r == "ok" else "error",
          "detail": "" if r == "ok" else r}
         for n, r in sorted(results.items())])
+
+
+# ------------------------------------------------- real-time ingest DDL
+
+_TS_LITERAL_RE = _re.compile(r"^timestamp\s+'((?:[^']|'')*)'$", _re.I)
+
+
+def _parse_sql_literal(tok: str):
+    """One VALUES literal -> python scalar: NULL, TRUE/FALSE, numbers,
+    'string' ('' escapes), TIMESTAMP 'iso'."""
+    t = tok.strip()
+    up = t.upper()
+    if up == "NULL":
+        return None
+    if up == "TRUE":
+        return 1
+    if up == "FALSE":
+        return 0
+    m = _TS_LITERAL_RE.match(t)
+    if m:
+        return m.group(1).replace("''", "'")
+    if t.startswith("'"):
+        if not t.endswith("'") or len(t) < 2:
+            raise UserError(f"unterminated string literal {tok!r}")
+        return t[1:-1].replace("''", "'")
+    try:
+        return int(t)
+    except ValueError:
+        pass
+    try:
+        return float(t)
+    except ValueError:
+        raise UserError(
+            f"cannot parse INSERT literal {tok!r}") from None
+
+
+def _run_insert(eng: Engine, table: str, cols: str,
+                values: str) -> pd.DataFrame:
+    """INSERT INTO t (a, b, ...) VALUES (...), (...) — the SQL spelling
+    of Engine.append (docs/INGEST.md). Literal lists are quote-aware
+    (strings may contain commas/parens); every tuple must match the
+    column list's arity."""
+    names = [c.strip() for c in cols.split(",") if c.strip()]
+    if not names:
+        raise UserError("INSERT INTO needs a column list")
+    rows = []
+    for tup in _split_top_commas(values):
+        t = tup.strip()
+        if not (t.startswith("(") and t.endswith(")")):
+            raise UserError(
+                f"INSERT VALUES expects parenthesized tuples, got "
+                f"{t[:40]!r}")
+        items = _split_top_commas(t[1:-1])
+        if len(items) != len(names):
+            raise UserError(
+                f"INSERT tuple has {len(items)} values for "
+                f"{len(names)} columns")
+        rows.append({n: _parse_sql_literal(v)
+                     for n, v in zip(names, items)})
+    out = eng.append(table, rows)
+    return pd.DataFrame([{
+        "table": table, "rows": out["rows"],
+        "delta_rows": out["delta_rows"],
+        "generation": out["generation"],
+        "wal_seq": out["wal_seq"]}])
+
+
+def _run_compact(eng: Engine, table: str) -> pd.DataFrame:
+    res = eng.compact_now(table)
+    if res is None:
+        return pd.DataFrame([{"table": table, "status": "empty-delta",
+                              "rows_sealed": 0, "ms": 0.0}])
+    if res.get("status") != "compacted":
+        # skipped, not empty: a compaction already in flight or the
+        # breaker is open — the operator should retry
+        return pd.DataFrame([{"table": table, "status": res["status"],
+                              "rows_sealed": 0, "ms": 0.0}])
+    return pd.DataFrame([{
+        "table": table, "status": "compacted",
+        "rows_sealed": res["rows_sealed"],
+        "ms": round(res["ms"], 3)}])
 
 
 def _run_clear(eng: Engine, table: str | None) -> pd.DataFrame:

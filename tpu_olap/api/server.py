@@ -14,6 +14,12 @@ equivalent is a JSON-over-HTTP surface (stdlib only, no new deps):
   POST /druid/v2     native Druid query JSON      -> Druid-wire results
                      (the raw-IR passthrough, SURVEY.md §4.5 — lets
                      existing Druid clients talk to the TPU engine)
+  POST /ingest       {"table": t, "rows": [{...}, ...]} -> real-time
+                     append (Engine.append; docs/INGEST.md): rows are
+                     queryable immediately, WAL-durable before the 200,
+                     and a full delta sheds with 429 + Retry-After
+  GET  /debug/ingest real-time ingest state: per-table delta sizes,
+                     watermarks, WAL bytes/lag, compactor state
   GET  /status       engine + per-table summary + counters
   GET  /status/metadata/<table>  column metadata (segmentMetadata shape)
   GET  /metrics      Prometheus text exposition (tpu_olap.obs.metrics:
@@ -277,10 +283,12 @@ class QueryServer:
         self.httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
-        # the JSONL event sink writes asynchronously: give the tail
-        # emitted by draining handlers (a final shed burst, a breaker
-        # trip) a bounded chance to reach disk before the process exits
-        self.engine.runner.events.flush(2.0)
+        # deterministic engine shutdown (ISSUE 13 satellite): stop and
+        # JOIN the background threads the engine owns — compactor, WAL
+        # flushers, cube maintainer — and flush the async event sink so
+        # the tail emitted by draining handlers reaches disk before the
+        # process exits. The engine stays queryable afterwards.
+        self.engine.close()
 
     @property
     def url(self) -> str:
@@ -375,6 +383,13 @@ class QueryServer:
             return {"enabled": bool(eng.config.cube_rewrite_enabled),
                     "auto_refresh": bool(eng.config.cube_auto_refresh),
                     "cubes": eng.cubes.snapshot()}
+        if path == "/debug/ingest" or path.startswith("/debug/ingest?"):
+            # real-time ingest state (segments/delta.py;
+            # docs/INGEST.md): per-table delta rows/segments, sealed
+            # watermark, WAL bytes + fsync lag, compactor state — the
+            # SQL spelling of the per-segment half is
+            # SELECT * FROM sys.segments (kind/watermark columns)
+            return self.engine.ingest.snapshot()
         if path == "/debug/cache" or path.startswith("/debug/cache?"):
             # semantic result-cache state (executor.resultcache;
             # docs/CACHING.md): per-tier entries/bytes/hit counters plus
@@ -434,6 +449,15 @@ class QueryServer:
             spec = json.loads(body)
             res = self.engine.execute_ir(spec)
             return res.druid, []
+        if path == "/ingest":
+            # real-time append (docs/INGEST.md): acknowledged only
+            # after the WAL frame is durable; backpressure surfaces as
+            # IngestBackpressure -> 429 + Retry-After via the taxonomy
+            req = json.loads(body)
+            if "table" not in req or "rows" not in req:
+                raise UserError(
+                    "/ingest expects {\"table\": ..., \"rows\": [...]}")
+            return self.engine.append(req["table"], req["rows"]), []
         if path == "/debug/profile" or path.startswith("/debug/profile?"):
             # on-demand device capture: blocks THIS handler thread for
             # the window while other threads keep serving (their

@@ -35,7 +35,13 @@ class TableEntry:
     parquet_read_cols: tuple | None = None   # pre-rename names, None = all
     parquet_column_map: dict | None = None
     parquet_rows: int | None = None          # footer-metadata row estimate
+    # real-time ingest (segments/delta.py; docs/INGEST.md): a zero-arg
+    # (version, frames) provider of the table's appended delta rows,
+    # set by the IngestManager — the fallback path's view of rows that
+    # arrived after registration. None until the first append.
+    delta_source: object = None
     _frame: object = None
+    _frame_aug: object = field(default=None, repr=False, compare=False)
     _frame_lock: object = field(default_factory=threading.Lock,
                                 repr=False, compare=False)
 
@@ -74,6 +80,13 @@ class TableEntry:
                     yield _rename(batch.to_pandas())
             finally:
                 pf.close()
+        # appended delta rows ride at the end of the sequential stream
+        # (the parallel per-worker path refuses when a delta exists —
+        # planner.fallback gates it — so rows are never double-counted)
+        ds = self.delta_source
+        if ds is not None:
+            for f in ds()[1]:
+                yield f
 
     def parquet_empty_frame(self):
         """0-row frame with the post-rename parquet schema (the chunked
@@ -102,7 +115,23 @@ class TableEntry:
                 if self._frame is None:
                     src = self.frame_source
                     self._frame = src() if callable(src) else src
-        return self._frame
+        ds = self.delta_source
+        if ds is None:
+            return self._frame
+        # appended delta rows (docs/INGEST.md): the fallback path sees
+        # base + every appended frame, memoized per delta version so a
+        # burst of fallback statements pays one concat per append
+        ver, frames = ds()
+        if not frames:
+            return self._frame
+        with self._frame_lock:
+            aug = self._frame_aug
+            if aug is not None and aug[0] == ver:
+                return aug[1]
+            import pandas as pd
+            cat = pd.concat([self._frame] + frames, ignore_index=True)
+            self._frame_aug = (ver, cat)
+            return cat
 
     @property
     def materialized_rows(self) -> int | None:

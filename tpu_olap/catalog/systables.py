@@ -117,22 +117,30 @@ def _segments_frame(engine) -> pd.DataFrame:
         if not e.is_accelerated:
             continue
         ts = e.segments
-        for s in ts.segments:
+        wm = ts.watermark
+        for sid, s in enumerate(ts.segments):
             nbytes = sum(int(a.nbytes) for a in s.columns.values()) \
                 + sum(int(a.nbytes) for a in s.null_masks.values())
+            sealed = ts.segment_sealed(sid)
             rows.append({
                 "table": name,
                 "segment_id": s.meta.segment_id,
                 "rows": s.meta.n_valid,
                 "time_min": s.meta.time_min,
                 "time_max": s.meta.time_max,
-                "generation": ts.generation,
+                # kind/watermark (docs/INGEST.md): sealed segments key
+                # caches by the sealed generation and are complete up
+                # to the table's watermark; delta blocks hold real-time
+                # appends awaiting compaction
+                "kind": "sealed" if sealed else "delta",
+                "generation": ts.segment_generation(sid),
+                "watermark": wm,
                 "bytes": nbytes,
                 "cache_pinned": (name, s.meta.segment_id) in pinned,
             })
     return pd.DataFrame(rows, columns=[
-        "table", "segment_id", "rows", "time_min", "time_max",
-        "generation", "bytes", "cache_pinned"])
+        "table", "segment_id", "rows", "time_min", "time_max", "kind",
+        "generation", "watermark", "bytes", "cache_pinned"])
 
 
 _QUERY_COLS = (

@@ -114,7 +114,10 @@ class CubeEntry:
 
     def snapshot_row(self, engine) -> dict:
         base = engine.catalog.maybe(self.spec.datasource)
-        base_gen = base.segments.generation \
+        # SEALED-scope generation (docs/INGEST.md): delta-only appends
+        # do not stale a cube — serves fold the delta remainder through
+        # the base path (planner.cuberewrite)
+        base_gen = base.segments.sealed_generation \
             if base is not None and base.is_accelerated else None
         data = self.data  # one read: a concurrent failed replace nulls it
         return {
@@ -292,7 +295,9 @@ class CubeRegistry:
             base = eng.catalog.maybe(e.spec.datasource)
             if base is None or not base.is_accelerated:
                 continue  # base gone: on_table_dropped handles real drops
-            gen = base.segments.generation
+            # sealed scope: a delta-only append must NOT queue a cube
+            # rebuild — only registration/compaction moves this
+            gen = base.segments.sealed_generation
             if e.status == "error" and e.attempted_generation == gen:
                 # the last attempt at THIS generation already failed;
                 # retrying every tick would re-run a device pass to the
@@ -328,9 +333,17 @@ class CubeRegistry:
             self._maintainer = t
             t.start()
 
-    def stop(self):
+    def stop(self, join: bool = False):
+        """Stop the maintainer; `join=True` (Engine.close) blocks until
+        the thread exits so shutdown is deterministic instead of
+        leaving an unjoined daemon behind."""
         self._stopped = True
         self._wake.set()
+        if join:
+            with self._lock:
+                t = self._maintainer
+            if t is not None and t.is_alive():
+                t.join(timeout=10.0)
 
     def _maintain_loop(self):
         """Background refresh: wait out the interval (or an ingest
@@ -364,7 +377,7 @@ class CubeRegistry:
                 if base is not None and base.is_accelerated \
                         and entry.status == "ready" \
                         and entry.base_generation \
-                        == base.segments.generation:
+                        == base.segments.sealed_generation:
                     return
             self._build_locked(entry, refresh)
 
@@ -388,7 +401,12 @@ class CubeRegistry:
                 raise CubeSpecError(
                     f"cube base table {spec.datasource!r} is not a "
                     "registered accelerated datasource")
-            table = base.segments  # pinned: generation-consistent view
+            # build over the SEALED scope only (docs/INGEST.md): the
+            # cube's partials must never swallow delta rows the
+            # compactor will later fold into a new sealed set — serves
+            # cover the delta remainder through the base path instead.
+            # With no delta this IS the live snapshot (zero cost).
+            table = base.segments.sealed_view()  # generation-consistent
             entry.attempted_generation = table.generation
             query = spec.build_query(eng)
             plan, present, compact, metrics = \

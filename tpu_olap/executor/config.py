@@ -182,6 +182,37 @@ class EngineConfig:
     # fast) un-pessimized on the base path. <= 1 disables the check.
     cube_serve_min_reduction: float = 16.0
 
+    # --- real-time ingest (segments/delta.py, segments/wal.py;
+    # docs/INGEST.md) --- Engine.append lands rows in a mutable
+    # in-memory delta scope, queryable immediately alongside sealed
+    # segments; a WAL makes acknowledged appends crash-durable and a
+    # background compactor seals deltas into time-partitioned segments.
+    # ingest_wal_dir: directory for per-table write-ahead logs; None
+    # disables durability (appends remain queryable, just not
+    # replayable after a crash).
+    ingest_wal_dir: str | None = None
+    # fsync policy: "always" (fsync before acknowledging — the full
+    # durability contract), "interval" (background flusher fsyncs every
+    # ingest_wal_flush_interval_s; process crashes lose nothing, power
+    # loss may lose the last interval), "never" (tests/benches).
+    ingest_wal_fsync: str = "always"
+    ingest_wal_flush_interval_s: float = 0.05
+    # replay an existing WAL when a table is first registered in this
+    # process (crash recovery); re-registering a live table always
+    # RESETS its log instead (the appends belonged to the old data)
+    ingest_wal_replay: bool = True
+    # backpressure bound: max delta rows per table before appends shed
+    # with 429 + Retry-After (ingest_retry_after_s); 0 = unbounded
+    ingest_max_delta_rows: int = 1 << 20
+    ingest_retry_after_s: float = 1.0
+    # background compactor: seal deltas >= ingest_compact_rows into
+    # time-partitioned sealed segments every ingest_compact_interval_s
+    # (ingest-woken). False = compact only via Engine.compact_now
+    # (deterministic for tests/benches).
+    ingest_auto_compact: bool = True
+    ingest_compact_rows: int = 1 << 16
+    ingest_compact_interval_s: float = 2.0
+
     # execution platform: "device" = default jax backend, "cpu" = numpy path
     platform: str = "device"
 

@@ -291,15 +291,8 @@ class ResultCache:
         if any(dp.kind == "timeformat" for dp in plan.dim_plans):
             return "timeformat dimension layout is interval-dependent"
         n_seg = len(plan.table.segments)
-        radix = 1  # _rows
-        for p in plan.agg_plans:
-            from tpu_olap.kernels.hll import NUM_REGISTERS
-            if p.kind == "hll":
-                radix += NUM_REGISTERS
-            elif p.kind == "theta":
-                radix += p.theta_k
-            else:
-                radix += 2  # value + _nn
+        from tpu_olap.kernels.groupby import partials_radix
+        radix = partials_radix(plan.agg_plans)
         state = n_seg * plan.total_groups * radix
         if state > self.config.segment_cache_state_budget:
             return (f"per-segment state {n_seg}x{plan.total_groups}"
@@ -320,11 +313,14 @@ class ResultCache:
     def get_segments(self, tkey, table, plan, seg_ids) -> dict:
         """{segment id: partials} for the cached subset of `seg_ids`,
         re-anchored to this plan's bucket layout.  Counts one hit/miss
-        per segment consulted."""
+        per segment consulted.  Keys carry each segment's SCOPE
+        generation (segments/segment.py): sealed segments share the
+        sealed generation, so their partials survive delta-only
+        appends — the overall generation only re-keys them when the
+        sealed set itself changes (registration, compaction)."""
         out = {}
-        gen = table.generation
         for sid in seg_ids:
-            key = (tkey, gen, sid)
+            key = (tkey, table.segment_generation(sid), sid)
             with self._lock:
                 e = self._seg.get(key)
                 if e is not None:
@@ -344,7 +340,7 @@ class ResultCache:
 
     def put_segment(self, tkey, table, plan, sid, partials):
         entry = _SegmentEntry(partials, plan, table.name)
-        key = (tkey, table.generation, sid)
+        key = (tkey, table.segment_generation(sid), sid)
         with self._lock:
             old = self._seg.pop(key, None)
             if old is not None:
@@ -418,9 +414,10 @@ class ResultCache:
     def count_bypass(self, tier: str = "segment"):
         self._count(tier, "bypass")
 
-    def clear(self, table: str | None = None) -> dict:
-        """Purge both tiers (optionally one table's entries).  Returns
-        {tier: purged count} for the cache_clear event."""
+    def clear(self, table: str | None = None,
+              tiers: tuple = ("full", "segment")) -> dict:
+        """Purge the given tiers (optionally one table's entries).
+        Returns {tier: purged count} for the cache_clear event."""
         purged = {"full": 0, "segment": 0}
         with self._lock:
             if table is None:
@@ -430,18 +427,20 @@ class ResultCache:
                 self._seg.clear()
                 self._full_bytes = self._seg_bytes = 0
             else:
-                for key in [k for k in list(self._full)
-                            if k[0] == table]:
-                    v = self._full.pop(key, None)
-                    if v is not None:
-                        self._full_bytes -= v[2]["nbytes"]
-                        purged["full"] += 1
-                for key in [k for k in list(self._seg)
-                            if k[0][0] == table]:
-                    v = self._seg.pop(key, None)
-                    if v is not None:
-                        self._seg_bytes -= v.nbytes
-                        purged["segment"] += 1
+                if "full" in tiers:
+                    for key in [k for k in list(self._full)
+                                if k[0] == table]:
+                        v = self._full.pop(key, None)
+                        if v is not None:
+                            self._full_bytes -= v[2]["nbytes"]
+                            purged["full"] += 1
+                if "segment" in tiers:
+                    for key in [k for k in list(self._seg)
+                                if k[0][0] == table]:
+                        v = self._seg.pop(key, None)
+                        if v is not None:
+                            self._seg_bytes -= v.nbytes
+                            purged["segment"] += 1
             self._refresh_gauges()
         return purged
 
@@ -453,6 +452,17 @@ class ResultCache:
         if self.events is not None and (purged["full"]
                                         or purged["segment"]):
             self.events.emit("cache_invalidate", table=table, **purged)
+        return purged
+
+    def invalidate_full(self, table: str):
+        """Tier-2-only purge for delta-only appends (docs/INGEST.md):
+        full results cover the delta so they are stale (and already
+        unreachable — the overall generation moved), but per-SEALED-
+        segment partials stay servable and must survive."""
+        purged = self.clear(table, tiers=("full",))
+        if self.events is not None and purged["full"]:
+            self.events.emit("cache_invalidate", table=table,
+                             scope="full", **purged)
         return purged
 
     def snapshot(self) -> dict:

@@ -1267,6 +1267,12 @@ class QueryRunner:
         key = table.name
         ds = self._datasets.get(key)
         if ds is None or ds.table is not table:
+            if ds is not None:
+                # a newer snapshot (append/compaction/re-registration)
+                # replaced this one: release the stale dataset's ledger
+                # accounting — in-flight queries that captured its env
+                # keep their buffers alive by reference
+                ds.evict()
             ds = DeviceDataset(table, self.config.platform, self.mesh,
                                self._hbm_ledger)
             self._datasets[key] = ds
@@ -1985,8 +1991,13 @@ class QueryRunner:
             # only segments ENTIRELY inside one query interval have
             # interval-independent partials; straddlers (and sub-floor
             # segments, where entry overhead beats the recompute win)
-            # are computed fresh every time and never stored
-            if sm.n_valid >= floor and any(
+            # are computed fresh every time and never stored. DELTA
+            # blocks (real-time appends, docs/INGEST.md) also always
+            # recompute: their contents change block-in-place across
+            # append snapshots, so caching them would churn the budget
+            # for entries one append away from unreachable.
+            if sm.n_valid >= floor and table.segment_sealed(sid) \
+                    and any(
                     iv.start <= sm.time_min and iv.end > sm.time_max
                     for iv in intervals):
                 covered.append(sid)

@@ -238,6 +238,24 @@ def compile_filter(spec, table, pool: ConstPool, virtual_exprs=None):
                 & ~_null_mask(env, col)
         # lexicographic bound over dictionary codes
         d = table.dictionaries[col]
+        if not getattr(d, "is_sorted", True):
+            # append-extended dictionary (unsorted tail, docs/INGEST.md):
+            # code order no longer tracks value order, so the bound
+            # lowers as a predicate table instead of a code-range
+            # compare — O(|dict|) host work, exact either way
+            def _in_bound(v, _s=s):
+                if _s.lower is not None and (
+                        v < _s.lower
+                        or (_s.lower_strict and v == _s.lower)):
+                    return False
+                if _s.upper is not None and (
+                        v > _s.upper
+                        or (_s.upper_strict and v == _s.upper)):
+                    return False
+                return True
+
+            cname = pool.add(d.predicate_table(_in_bound))
+            return lambda env, c: c[cname][env["cols"][col]]
         lo, hi = d.bound_code_range(s.lower, s.upper, s.lower_strict,
                                     s.upper_strict)
         clo = pool.add(lo, np.int32)

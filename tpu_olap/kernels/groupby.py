@@ -249,6 +249,24 @@ def group_reduce_batch(legs, consts_by_leg) -> list:
             in zip(legs, consts_by_leg)]
 
 
+def partials_radix(plans) -> int:
+    """Per-group state width (in scalar slots) of a partial-aggregate
+    dict: 1 for _rows, then each agg's unfinalized representation —
+    the HLL register file, the theta table, or value + _nn. Shared by
+    every state-budget guard over partials (segment-cache bypass, cube
+    serve, delta fold) so the widths cannot drift apart."""
+    from tpu_olap.kernels.hll import NUM_REGISTERS
+    radix = 1  # _rows
+    for p in plans:
+        if p.kind == "hll":
+            radix += NUM_REGISTERS
+        elif p.kind == "theta":
+            radix += p.theta_k
+        else:
+            radix += 2  # value + _nn
+    return radix
+
+
 def merge_partials(a: dict, b: dict, plans) -> dict:
     """Merge two partial-aggregate dicts (tree-reduce across segments; the
     same op runs as an ICI collective across chips)."""

@@ -40,7 +40,7 @@ from tpu_olap.cubes.spec import agg_signature, period_contains
 from tpu_olap.ir.granularity import AllGranularity, PeriodGranularity
 from tpu_olap.ir.query import (GroupByQuerySpec, TimeseriesQuerySpec,
                                TopNQuerySpec)
-from tpu_olap.kernels.groupby import build_group_key
+from tpu_olap.kernels.groupby import build_group_key, partials_radix
 from tpu_olap.kernels.hll import NUM_REGISTERS
 from tpu_olap.kernels.theta import EMPTY as THETA_EMPTY
 from tpu_olap.obs.trace import span as _span
@@ -153,14 +153,7 @@ def _covering_reason(query, phys, spec, data, config) -> str | None:
                     f"the query's {p.theta_k}")
 
     # ---- fold state budget (same shape as the segment-cache guard)
-    radix = 1
-    for p in phys.agg_plans:
-        if p.kind == "hll":
-            radix += NUM_REGISTERS
-        elif p.kind == "theta":
-            radix += p.theta_k
-        else:
-            radix += 2
+    radix = partials_radix(phys.agg_plans)
     if phys.total_groups * radix > config.cube_serve_state_budget:
         return (f"fold state {phys.total_groups}x{radix} exceeds "
                 "cube_serve_state_budget")
@@ -311,6 +304,49 @@ def _fold_partials(query, phys, data, env, keep, fmask):
     return out, len(rows_idx)
 
 
+def _delta_fold_reason(phys, delta_ids, config) -> str | None:
+    """None when the delta remainder can fold through the base path
+    (QueryRunner._run_seg_partials), else why the cube must refuse —
+    the same shape guards the tier-1 segment cache applies."""
+    if phys.key_fn is None:
+        return "delta fold needs a dense key_fn plan"
+    radix = partials_radix(phys.agg_plans)
+    W = max(delta_ids) - min(delta_ids) + 1
+    if W * phys.total_groups * radix \
+            > config.segment_cache_state_budget:
+        return (f"delta fold state {W}x{phys.total_groups}x{radix} "
+                "exceeds segment_cache_state_budget")
+    if W * phys.total_groups >= (1 << 31):
+        return "delta fold key space overflows int32"
+    return None
+
+
+def _merge_delta_partials(engine, runner, phys, partials, delta_ids,
+                          table_name):
+    """Compute the delta segments' partials on the device (one pass,
+    per-segment keyed — QueryRunner._run_seg_partials, the machinery
+    the tier-1 cache already trusts) and merge them into the cube's
+    sealed-scope fold. Runs under its own admission slot: the cube
+    serve path never entered QueryRunner.execute, and background-vs-
+    foreground fairness must hold for the delta dispatch too."""
+    import functools as _ft
+
+    from tpu_olap.kernels.groupby import merge_partials
+
+    dmet: dict = {}
+    with runner.admission.slot(engine.config.query_deadline_s):
+        runner.breaker.check()
+        fresh = runner._dispatch(
+            lambda: runner._run_seg_partials(phys, dmet,
+                                             sorted(delta_ids)),
+            dmet, table_name)
+    dparts = _ft.reduce(
+        lambda a, b: merge_partials(a, b, phys.agg_plans),
+        fresh.values())
+    return merge_partials(partials, dparts, phys.agg_plans), \
+        int(dmet.get("rows_scanned") or 0)
+
+
 def try_serve_cube(engine, plan_result):
     """Serve `plan_result.query` from the smallest covering cube, or
     return None (the caller proceeds to the base-table device path).
@@ -327,7 +363,10 @@ def try_serve_cube(engine, plan_result):
     if in_introspection():
         return None
     table = entry.segments
-    candidates = registry.serveable(entry.name, table.generation)
+    # SEALED-scope generation (docs/INGEST.md): a cube is current as
+    # long as the sealed set it was built from is — delta-only appends
+    # do not stale it; their rows fold through the base path below
+    candidates = registry.serveable(entry.name, table.sealed_generation)
     if not candidates:
         # distinguish "stale only" from "nothing registered" so an
         # operator can see invalidation working in /metrics
@@ -369,10 +408,10 @@ def try_serve_cube(engine, plan_result):
                 # the measurement), so a cube that isn't a clear row-
                 # count win would PESSIMIZE a query manifest pruning
                 # already made cheap — leave those on the base path
+                kept_n = int(np.count_nonzero(keep))
                 min_red = float(
                     engine.config.cube_serve_min_reduction or 0.0)
                 if min_red > 1.0:
-                    kept_n = int(np.count_nonzero(keep))
                     base_rows = sum(
                         phys.table.segments[i].meta.n_valid
                         for i in phys.pruned_ids)
@@ -381,12 +420,34 @@ def try_serve_cube(engine, plan_result):
                                   f">={min_red:g}x reduction of the "
                                   f"{base_rows}-row base scan")
                         continue
+                # delta remainder (docs/INGEST.md): rows appended since
+                # the sealed set the cube covers fold through the BASE
+                # path — exact per-segment partials in this plan's own
+                # layout (interval + WHERE handled by key_fn, the same
+                # code the tier-1 cache trusts for straddlers), merged
+                # with the cube's sealed-scope fold before finalize.
+                # Zero stale serves by construction: sealed rows come
+                # from the cube, delta rows from the live snapshot,
+                # and the scopes are disjoint.
+                delta_ids = [sid for sid in phys.pruned_ids
+                             if not table.segment_sealed(sid)]
+                if delta_ids:
+                    reason = _delta_fold_reason(phys, delta_ids,
+                                                engine.config)
+                    if reason is not None:
+                        continue
                 env = _dim_env(phys, data, keep)
                 fmask = _filter_mask(query, phys, env, kept_n)
                 partials, scanned = _fold_partials(
                     query, phys, data, env, keep, fmask)
+                if delta_ids:
+                    partials, delta_scanned = _merge_delta_partials(
+                        engine, runner, phys, partials, delta_ids,
+                        entry.name)
+                    scanned += delta_scanned
                 res = _finish(runner, query, phys, partials)
-                sp.set(cube=cube.spec.name, cube_rows_scanned=scanned)
+                sp.set(cube=cube.spec.name, cube_rows_scanned=scanned,
+                       delta_segments=len(delta_ids))
                 registry.note_serve(cube)
                 registry.count_request("served")
                 m = {"query_type": query.query_type,
@@ -394,7 +455,8 @@ def try_serve_cube(engine, plan_result):
                      "cube": cube.spec.name,
                      "cube_rows": data.n_rows,
                      "rows_scanned": int(scanned),
-                     "segments_scanned": 0,
+                     "delta_segments": len(delta_ids),
+                     "segments_scanned": len(delta_ids),
                      "segments_total": len(table.segments),
                      "cache_hit": False,
                      "rows_returned": len(res.rows),

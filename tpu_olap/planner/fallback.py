@@ -1473,6 +1473,12 @@ def _parallel_chunk_partials(stmt, entry, catalog, config, time_col,
     paths = entry.parquet_paths if entry is not None else None
     if not paths:
         return None
+    ds = getattr(entry, "delta_source", None)
+    if ds is not None and ds()[1]:
+        # appended delta rows (docs/INGEST.md) ride only the sequential
+        # iter_chunks tail; per-worker row-group units would miss them
+        # (or the leader would double-count) — take the sequential path
+        return None
     workers = config.fallback_parallel_workers
     if workers == 0:
         workers = min(8, _os.cpu_count() or 1)

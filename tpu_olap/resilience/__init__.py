@@ -15,18 +15,21 @@ scan (SURVEY.md §2 property 2, §6). This package adds what happens
              probe and routes fallback-capable queries to the
              interpreter while open (path="fallback_breaker")
 - faults:    the generalized EngineConfig.fault_injector call sites
-             (dispatch / host-transfer / reprobe / ingest / batch-leg)
+             (dispatch / host-transfer / reprobe / ingest / batch-leg /
+             append / wal-write / wal-replay / compact)
 """
 
 from tpu_olap.resilience.admission import AdmissionController
 from tpu_olap.resilience.breaker import CircuitBreaker
 from tpu_olap.resilience.errors import (BreakerOpen, DeviceFailure,
+                                        IngestBackpressure,
                                         InternalError, QueryError,
                                         QueryShed, UserError)
 from tpu_olap.resilience.faults import FaultInjector, maybe_inject
 
 __all__ = [
     "AdmissionController", "BreakerOpen", "CircuitBreaker",
-    "DeviceFailure", "FaultInjector", "InternalError", "QueryError",
-    "QueryShed", "UserError", "maybe_inject",
+    "DeviceFailure", "FaultInjector", "IngestBackpressure",
+    "InternalError", "QueryError", "QueryShed", "UserError",
+    "maybe_inject",
 ]

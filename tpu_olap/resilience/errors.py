@@ -73,6 +73,22 @@ class BreakerOpen(QueryError):
         self.retry_after_s = max(0.0, float(retry_after_s))
 
 
+class IngestBackpressure(QueryError):
+    """Real-time append rejected: the table's in-memory delta is at
+    `ingest_max_delta_rows` and accepting more would grow host memory
+    unboundedly ahead of the compactor. Explicit 429 + Retry-After —
+    never a silent drop; retry after the compactor drains the delta
+    (docs/INGEST.md)."""
+
+    code = "ingest_backpressure"
+    retriable = True
+    http_status = 429
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = max(0.0, float(retry_after_s))
+
+
 class DeviceFailure(QueryError):
     """Device dispatch failed after retries exhausted and no fallback
     was available (fallback_on_device_failure=False, or a raw-IR

@@ -9,6 +9,15 @@ resilience layer must survive, not just the device dispatch:
     reprobe        the post-wedge / healer device probe
     ingest         Engine.register_table's segment build
     batch-leg      per-leg finalize of a fused shared-scan dispatch
+    append         Engine.append before any state change (crash before
+                   the WAL write: the batch is fully absent)
+    wal-write      just before the WAL frame write (crash before
+                   durability: the batch was never acknowledged)
+    wal-replay     per replayed record during crash recovery (a crash
+                   mid-recovery leaves the table cleanly base-only;
+                   re-registration replays again)
+    compact        the background compactor before the sealed-set swap
+                   (a crashed compaction leaves the delta intact)
 
 Backwards compatibility: a plain callable (no ``stages`` attribute)
 fires ONLY at the classic ``dispatch`` site, exactly as before — every
@@ -28,7 +37,8 @@ from __future__ import annotations
 LEGACY_STAGES = ("dispatch",)
 
 ALL_STAGES = ("dispatch", "host-transfer", "reprobe", "ingest",
-              "batch-leg")
+              "batch-leg", "append", "wal-write", "wal-replay",
+              "compact")
 
 
 def maybe_inject(config, stage: str, attempt: int = 0) -> None:

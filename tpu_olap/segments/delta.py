@@ -1,0 +1,939 @@
+"""Real-time ingest: mutable delta segments, WAL durability, and the
+backpressured background compactor (docs/INGEST.md).
+
+The Druid half of the reference system served queries over *realtime
+nodes* — freshly-arrived rows answered immediately from mutable
+in-memory state while batch segments compacted behind them. This module
+is that path for the in-process engine:
+
+- `Engine.append(table, rows)` lands rows in the table's DELTA: frozen
+  append blocks swapped in as a fresh `TableSegments` snapshot (sealed
+  segment objects, dictionaries, and earlier delta blocks are shared;
+  only the partially-filled tail block is rebuilt copy-on-write), so a
+  query that grabbed the previous snapshot keeps an immutable,
+  generation-consistent view while the next query sees the new rows —
+  through the SAME lowering/kernels/caches as batch data, no separate
+  read path.
+- Every accepted append is first framed into the table's write-ahead
+  log (`segments.wal`); acknowledgment follows durability, and a
+  crash/SIGKILL replays the log to the exact acknowledged state at the
+  next registration.
+- A background compactor seals the delta: all rows re-emit through the
+  batch `StreamIngestor` (time-sorted, time-partitioned, dictionary
+  re-sorted, dtypes re-narrowed) into a fresh sealed set, while
+  appends that raced the compaction are carried over as rebased delta
+  blocks — the write path never blocks the compactor and vice versa
+  beyond a short swap section ("Partial Partial Aggregates",
+  PAPERS.md 2603.26698; contention model PAPERS.md 1311.0059).
+- A bounded delta (`ingest_max_delta_rows`) drives write backpressure:
+  `IngestBackpressure` -> HTTP 429 + Retry-After, never a silent drop.
+
+Generation contract (the robustness headline): append snapshots take a
+fresh overall `generation` (tier-2 full-result cache entries and cube
+full-serve keys go stale at key level) but carry the predecessor's
+`sealed_generation`, so per-sealed-segment tier-1 cache partials and
+generation-current cubes SURVIVE delta-only appends — cube serves clip
+at the sealed scope and fold the delta remainder through the base path
+(planner.cuberewrite), zero stale serves by construction.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as np
+
+from tpu_olap.resilience.errors import (IngestBackpressure, QueryShed,
+                                        UserError)
+from tpu_olap.resilience.faults import maybe_inject
+from tpu_olap.segments.segment import (ColumnType, Segment, SegmentMeta,
+                                       TableSegments, TIME_COLUMN,
+                                       _scalar)
+from tpu_olap.segments.wal import WriteAheadLog, replay_wal, wal_path
+
+__all__ = ["IngestManager", "canonicalize_rows", "encode_rows",
+           "extend_snapshot", "compact_table"]
+
+
+# --------------------------------------------------------------------------
+# row canonicalization (the WAL wire format IS the append input format)
+
+def _to_ms(v):
+    """Any reasonable time spelling -> epoch millis int (None stays
+    None for the caller's null check)."""
+    if v is None:
+        return None
+    if isinstance(v, bool):
+        raise UserError(f"cannot use boolean {v!r} as a timestamp")
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    if isinstance(v, (float, np.floating)):
+        if np.isnan(v):
+            return None
+        return int(v)
+    import pandas as pd
+    ts = pd.Timestamp(v)
+    if ts is pd.NaT:
+        return None
+    return int(ts.value // 1_000_000)
+
+
+def _canon_scalar(v):
+    """JSON-native canonical value: what the WAL stores and the encoder
+    consumes, so a replayed batch is bit-identical to the live one."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        f = float(v)
+        return None if np.isnan(f) else f
+    if isinstance(v, np.bool_):
+        return bool(v)
+    try:
+        import pandas as pd
+        if pd.isna(v):
+            return None
+    except (TypeError, ValueError):
+        pass
+    return str(v)
+
+
+def canonicalize_rows(rows, time_column: str | None) -> list:
+    """list[dict] / DataFrame -> canonical rows: JSON-native scalars
+    only, the time value (accepted under the table's registered time
+    column name or ``__time``) normalized to epoch-millis under
+    ``__time``. This is exactly what the WAL frames, so replay feeds
+    the same dicts back through the same encoder."""
+    import pandas as pd
+    if isinstance(rows, pd.DataFrame):
+        rows = rows.to_dict("records")
+    out = []
+    for r in rows:
+        if not isinstance(r, dict):
+            raise UserError(
+                f"append rows must be dicts, got {type(r).__name__}")
+        cr = {}
+        for k, v in r.items():
+            k = str(k)
+            if k == TIME_COLUMN or (time_column is not None
+                                    and k == time_column):
+                cr[TIME_COLUMN] = _to_ms(v)
+            else:
+                cr[k] = _canon_scalar(v)
+        out.append(cr)
+    return out
+
+
+# --------------------------------------------------------------------------
+# encoding: canonical rows -> column arrays against a live snapshot
+
+class EncodedBatch:
+    __slots__ = ("n", "cols", "nulls", "new_dict_values")
+
+    def __init__(self, n, cols, nulls, new_dict_values):
+        self.n = n
+        self.cols = cols                    # col -> ndarray[n]
+        self.nulls = nulls                  # col -> bool[n] (any() true)
+        self.new_dict_values = new_dict_values  # col -> [unseen values]
+
+
+def encode_rows(table: TableSegments, rows: list,
+                require_time: bool) -> EncodedBatch:
+    """Validate + encode canonical rows against the snapshot's schema
+    and dictionaries. Unseen string values take tail codes past the
+    current dictionary (the `Dictionary.extended` contract: existing
+    codes never move). Raises UserError before ANY state changes, so a
+    bad batch is rejected whole — never half-applied."""
+    schema = table.schema
+    n = len(rows)
+    unknown = set()
+    for r in rows:
+        unknown.update(k for k in r if k not in schema)
+    if unknown:
+        raise UserError(
+            f"append to {table.name!r}: unknown column(s) "
+            f"{sorted(unknown)} (schema: {sorted(schema)})")
+    cols: dict = {}
+    nulls: dict = {}
+    new_vals: dict = {}
+    for c, typ in schema.items():
+        if c == TIME_COLUMN:
+            arr = np.zeros(n, np.int64)
+            for i, r in enumerate(rows):
+                v = r.get(TIME_COLUMN)
+                if v is None:
+                    if require_time:
+                        raise UserError(
+                            f"append to {table.name!r}: a non-null time "
+                            "value is required per row (like Druid's "
+                            "__time)")
+                    v = 0
+                arr[i] = int(v)
+            cols[c] = arr
+            continue
+        if typ is ColumnType.STRING:
+            d = table.dictionaries.get(c)
+            base = d.cardinality if d is not None else 0
+            codes = np.zeros(n, np.int32)
+            pending: dict = {}
+            news: list = []
+            for i, r in enumerate(rows):
+                v = r.get(c)
+                if v is None:
+                    continue
+                v = str(v)
+                code = d.id_of(v) if d is not None else -1
+                if code <= 0:
+                    code = pending.get(v)
+                    if code is None:
+                        code = base + len(news) + 1
+                        news.append(v)
+                        pending[v] = code
+                codes[i] = code
+            cols[c] = codes
+            if news:
+                new_vals[c] = news
+            continue
+        mask = np.zeros(n, bool)
+        if typ is ColumnType.LONG:
+            arr = np.zeros(n, np.int64)
+            for i, r in enumerate(rows):
+                v = r.get(c)
+                if v is None:
+                    mask[i] = True
+                    continue
+                try:
+                    arr[i] = int(v)
+                except (TypeError, ValueError):
+                    raise UserError(
+                        f"append to {table.name!r}: column {c!r} is "
+                        f"LONG, got {v!r}") from None
+        else:
+            arr = np.zeros(n, np.float64)
+            for i, r in enumerate(rows):
+                v = r.get(c)
+                if v is None:
+                    mask[i] = True
+                    continue
+                try:
+                    f = float(v)
+                except (TypeError, ValueError):
+                    raise UserError(
+                        f"append to {table.name!r}: column {c!r} is "
+                        f"DOUBLE, got {v!r}") from None
+                if np.isnan(f):
+                    mask[i] = True
+                else:
+                    arr[i] = f
+        cols[c] = arr
+        if mask.any():
+            nulls[c] = mask
+    return EncodedBatch(n, cols, nulls, new_vals)
+
+
+# --------------------------------------------------------------------------
+# delta block emission + snapshot extension
+
+def _emit_blocks(schema: dict, block_rows: int, cols: dict, nulls: dict,
+                 start_sid: int) -> list:
+    """Row arrays -> padded fixed-size Segment blocks with exact metas
+    (the same manifest StreamIngestor._emit_block writes, so interval
+    and numeric-bound pruning treat delta blocks like sealed ones).
+    Rows keep ARRIVAL order — Druid realtime segments are not
+    row-sorted either; per-block time_min/max stay exact."""
+    n = len(cols[TIME_COLUMN])
+    out = []
+    for lo in range(0, n, block_rows):
+        hi = min(lo + block_rows, n)
+        nv = hi - lo
+        bcols, bmasks = {}, {}
+        for c, v in cols.items():
+            block = np.zeros(block_rows, dtype=v.dtype)
+            block[:nv] = v[lo:hi]
+            bcols[c] = block
+        for c, m in nulls.items():
+            mm = m[lo:hi]
+            if not mm.any():
+                continue
+            block = np.zeros(block_rows, dtype=bool)
+            block[:nv] = mm
+            bmasks[c] = block
+        t = bcols[TIME_COLUMN][:nv]
+        meta = SegmentMeta(
+            segment_id=start_sid + len(out), n_valid=nv,
+            time_min=int(t.min()) if nv else 0,
+            time_max=int(t.max()) if nv else 0)
+        for c, typ in schema.items():
+            if typ is not ColumnType.STRING and nv:
+                cv = bcols[c][:nv]
+                nm = bmasks.get(c)
+                if nm is not None:
+                    if nm[:nv].all():
+                        continue
+                    cv = cv[~nm[:nv]]
+                meta.column_min[c] = _scalar(cv.min())
+                meta.column_max[c] = _scalar(cv.max())
+        out.append(Segment(meta, bcols, bmasks))
+    return out
+
+
+def extend_snapshot(table: TableSegments,
+                    enc: EncodedBatch) -> TableSegments:
+    """New snapshot = sealed segments (shared) + delta blocks (shared,
+    except a partially-filled tail rebuilt copy-on-write to absorb the
+    batch) + extended dictionaries. Takes a fresh overall generation;
+    carries the sealed generation (docs/INGEST.md)."""
+    sealed = table.segments[:table.sealed_count]
+    delta = list(table.segments[table.sealed_count:])
+    dicts = dict(table.dictionaries)
+    for c, vals in enc.new_dict_values.items():
+        dicts[c] = dicts[c].extended(vals)
+    cols, nulls = enc.cols, dict(enc.nulls)
+    if delta and delta[-1].meta.n_valid < table.block_rows:
+        # absorb into the tail block: copy its valid rows in front of
+        # the batch (the OLD tail object stays untouched — snapshots
+        # that hold it keep serving it)
+        tail = delta.pop()
+        tv = tail.meta.n_valid
+        cols = {c: np.concatenate([np.asarray(tail.columns[c][:tv]), v])
+                for c, v in cols.items()}
+        merged: dict = {}
+        for c in set(tail.null_masks) | set(nulls):
+            a = tail.null_masks[c][:tv] if c in tail.null_masks \
+                else np.zeros(tv, bool)
+            b = nulls.get(c)
+            if b is None:
+                b = np.zeros(enc.n, bool)
+            m = np.concatenate([a, b])
+            if m.any():
+                merged[c] = m
+        nulls = merged
+    sid = table.sealed_count + len(delta)
+    blocks = _emit_blocks(table.schema, table.block_rows, cols, nulls,
+                          sid)
+    out = TableSegments(table.name, table.schema, dicts,
+                        sealed + delta + blocks, table.block_rows,
+                        sealed_count=table.sealed_count,
+                        sealed_generation=table.sealed_generation)
+    out.time_partition = table.time_partition
+    out.star = table.star
+    return out
+
+
+# --------------------------------------------------------------------------
+# compaction
+
+def compact_table(table: TableSegments) -> TableSegments:
+    """Seal the snapshot: EVERY row (sealed + delta) re-emitted through
+    the batch StreamIngestor — globally re-time-sorted into the table's
+    calendar partitions, dictionary re-sorted (restoring the code-range
+    fast path for lexicographic bounds), dtypes re-narrowed. Returns a
+    pure sealed TableSegments (fresh sealed generation); the caller
+    rebases any delta blocks that raced in."""
+    from tpu_olap.segments.ingest import (DictBuilder, StreamIngestor,
+                                          resolve_time_partition)
+    t_lo, t_hi = table.time_boundary
+    tp = table.time_partition
+    if tp is None:
+        tp = resolve_time_partition("auto", t_lo or None, t_hi or None,
+                                    table.num_rows, table.block_rows)
+    ing = StreamIngestor(table.name, None, table.block_rows, tp)
+    ing.schema = dict(table.schema)
+    for c, d in table.dictionaries.items():
+        # seed the builder with the live dictionary: value -> current
+        # code, so stored codes ARE valid temp codes and finalize()'s
+        # sort+remap handles the unsorted append tail for free
+        b = DictBuilder()
+        b._map = {str(v): i + 1 for i, v in enumerate(d.values)}
+        ing._dicts[c] = b
+    for s in table.segments:
+        nv = s.meta.n_valid
+        if not nv:
+            continue
+        ing._pending.append(
+            {c: np.asarray(v[:nv]) for c, v in s.columns.items()})
+        ing._pending_nulls.append(
+            {c: np.asarray(m[:nv]) for c, m in s.null_masks.items()})
+        ing._pending_rows += nv
+    out = ing.finalize()
+    out.star = table.star
+    return out
+
+
+def _remap_codes(live_dict, merged_dict) -> np.ndarray:
+    """[live code] -> merged code (0 stays null)."""
+    r = np.zeros(live_dict.cardinality + 1, np.int64)
+    for i, v in enumerate(live_dict.values):
+        r[i + 1] = merged_dict.id_of(v)
+    return r
+
+
+def _gather_delta_rows(table: TableSegments, skip: int):
+    """Valid delta rows in append order, minus the first `skip` (the
+    rows a compaction snapshot already covered)."""
+    delta = table.segments[table.sealed_count:]
+    cols = {}
+    for c in table.schema:
+        cols[c] = np.concatenate(
+            [np.asarray(s.columns[c][:s.meta.n_valid]) for s in delta]
+        )[skip:] if delta else np.zeros(0, np.int64)
+    nulls = {}
+    mask_cols = set().union(*(s.null_masks.keys() for s in delta)) \
+        if delta else set()
+    for c in mask_cols:
+        m = np.concatenate(
+            [np.asarray(s.null_masks[c][:s.meta.n_valid])
+             if c in s.null_masks else np.zeros(s.meta.n_valid, bool)
+             for s in delta])[skip:]
+        if m.any():
+            nulls[c] = m
+    return cols, nulls
+
+
+# --------------------------------------------------------------------------
+# the engine-side coordinator
+
+class TableIngestState:
+    """Per-table mutable ingest state. `lock` serializes append
+    snapshot swaps, WAL writes, and the compactor's swap section —
+    never held across the compaction rebuild itself."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.lock = threading.RLock()
+        self.wal: WriteAheadLog | None = None
+        self.frames: list = []   # delta-resident pandas frames (fallback)
+        self.frames_version = 0  # bumped on EVERY frames mutation: the
+        #                          TableEntry._frame_aug memo key (frame
+        #                          count alone could collide after a
+        #                          compaction trims the list)
+        self.appended_rows = 0
+        self.acked_seq = 0
+        self.replayed_rows = 0
+        self.compactions = 0
+        self.last_compact_ms = 0.0
+        self.compacting = False
+
+    def delta_source(self):
+        """(version, frames) provider TableEntry.frame concatenates —
+        the interpreter/fallback path's view of appended rows. Reads
+        under the ingest lock so the pair stays consistent with a
+        racing compaction's trim."""
+        with self.lock:
+            return self.frames_version, list(self.frames)
+
+
+class IngestManager:
+    """All real-time ingest state of one Engine: per-table delta
+    states, WAL lifecycles, replay-on-register, the backpressure gate,
+    and the background compactor thread (docs/INGEST.md)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.config = engine.config
+        self._lock = threading.Lock()
+        self._states: dict[str, TableIngestState] = {}
+        self._wake = threading.Event()
+        self._compactor: threading.Thread | None = None
+        self._stopped = False
+        m = engine.metrics
+        self._m_rows = m.counter(
+            "ingest_rows_total",
+            "Rows appended through the real-time ingest path "
+            "(Engine.append / POST /ingest / INSERT INTO).", ("table",))
+        self._m_backpressure = m.counter(
+            "ingest_backpressure_total",
+            "Appends rejected with 429 because the delta hit "
+            "ingest_max_delta_rows.", ("table",))
+        self._m_delta = m.gauge(
+            "delta_rows",
+            "Rows currently resident in the mutable delta scope.",
+            ("table",))
+        self._m_wal = m.gauge(
+            "wal_bytes", "Bytes in the table's write-ahead log.",
+            ("table",))
+        self._m_compact = m.counter(
+            "compactions_total",
+            "Delta-to-sealed compactions completed.", ("table",))
+        self._m_compact_err = m.counter(
+            "compact_errors_total",
+            "Background compactions that raised (retried next tick).",
+            ("table",))
+
+    # ----------------------------------------------------------- helpers
+
+    def _state(self, name: str) -> TableIngestState:
+        with self._lock:
+            st = self._states.get(name)
+            if st is None:
+                st = self._states[name] = TableIngestState(name)
+            return st
+
+    def _wal_for(self, st: TableIngestState) -> WriteAheadLog | None:
+        cfg = self.config
+        if not cfg.ingest_wal_dir:
+            return None
+        if st.wal is not None and st.wal.tainted:
+            # taint is sticky across close(): never silently reopen a
+            # log whose tail may hold an unacknowledged frame
+            raise RuntimeError(
+                f"WAL {st.wal.path} failed a write that could not be "
+                "rolled back; re-register the table to reset it")
+        if st.wal is None or st.wal._closed:
+            st.wal = WriteAheadLog(
+                wal_path(cfg.ingest_wal_dir, st.name),
+                fsync=cfg.ingest_wal_fsync,
+                flush_interval_s=cfg.ingest_wal_flush_interval_s,
+                start_seq=st.acked_seq)
+        return st.wal
+
+    @staticmethod
+    def _delta_frame(entry, canon_rows):
+        """Canonical rows -> a fallback-path frame matching the base
+        frame's visible schema (time re-materialized as datetime under
+        the registered time column name)."""
+        import pandas as pd
+        df = pd.DataFrame(canon_rows)
+        if TIME_COLUMN in df.columns:
+            ts = pd.to_datetime(df[TIME_COLUMN], unit="ms")
+            df = df.drop(columns=[TIME_COLUMN])
+            df[entry.time_column or TIME_COLUMN] = ts
+        return df
+
+    # ------------------------------------------------------------ append
+
+    def append(self, name: str, rows) -> dict:
+        """The Engine.append implementation: validate -> backpressure
+        gate -> WAL frame (durability precedes acknowledgment) ->
+        snapshot swap -> cache invalidation scoped to what actually
+        changed (tier-2 only; sealed tier-1 partials and cubes
+        survive)."""
+        eng = self.engine
+        cfg = self.config
+        entry = eng.catalog.get(name)
+        if not entry.is_accelerated:
+            raise UserError(
+                f"table {name!r} is not accelerated; append needs a "
+                "segment-backed datasource")
+        if name.startswith("__cube_"):
+            raise UserError(
+                "cube storage tables are rebuilt from their base "
+                "table; append to the base instead")
+        canon = canonicalize_rows(rows, entry.time_column)
+        if not canon:
+            table = entry.segments
+            return {"table": name, "rows": 0,
+                    "generation": table.generation,
+                    "sealed_generation": table.sealed_generation,
+                    "delta_rows": table.delta_rows,
+                    "watermark": table.watermark, "wal_seq": None}
+        maybe_inject(cfg, "append", 0)
+        st = self._state(name)
+        with st.lock:
+            table = entry.segments
+            cap = int(cfg.ingest_max_delta_rows or 0)
+            if cap and table.delta_rows + len(canon) > cap:
+                self._m_backpressure.inc(table=name)
+                self._ensure_compactor()
+                self._wake.set()
+                raise IngestBackpressure(
+                    f"delta for {name!r} holds {table.delta_rows} rows;"
+                    f" +{len(canon)} would exceed ingest_max_delta_rows"
+                    f"={cap} — retry after compaction",
+                    retry_after_s=cfg.ingest_retry_after_s)
+            # validation/encoding BEFORE the WAL write: a rejected
+            # batch must never reach the durable log. The fallback
+            # frame too — pd.to_datetime bounds are narrower than the
+            # raw epoch-ms range the encoder accepts, and a failure
+            # after the WAL ack would leave the batch durable+device-
+            # visible but absent from the interpreter's view
+            enc = encode_rows(table, canon,
+                              require_time=entry.time_column is not None)
+            delta_frame = self._delta_frame(entry, canon)
+            seq = wal_bytes = None
+            wal = self._wal_for(st)
+            if wal is not None:
+                maybe_inject(cfg, "wal-write", 0)
+                seq, wal_bytes = wal.append(canon)
+                st.acked_seq = seq
+            new_table = extend_snapshot(table, enc)
+            entry.segments = new_table
+            st.frames.append(delta_frame)
+            st.frames_version += 1
+            st.appended_rows += len(canon)
+            entry.delta_source = st.delta_source
+            entry._frame_aug = None
+        runner = eng.runner
+        # scoped invalidation (the PR 9 contract, split per scope):
+        # whole-result state is stale (keys carry the moved overall
+        # generation; purge eagerly), sealed-segment partials are NOT
+        # (their scope generation did not move) — docs/INGEST.md
+        runner.result_cache.invalidate_full(name)
+        self._m_rows.inc(len(canon), table=name)
+        self._m_delta.set(new_table.delta_rows, table=name)
+        if wal_bytes is not None:
+            self._m_wal.set(wal_bytes, table=name)
+        runner.events.emit(
+            "ingest", table=name, kind="append", rows=len(canon),
+            generation=new_table.generation,
+            sealed_generation=new_table.sealed_generation,
+            delta_rows=new_table.delta_rows, wal_seq=seq)
+        if cfg.ingest_auto_compact and \
+                new_table.delta_rows >= int(cfg.ingest_compact_rows):
+            self._ensure_compactor()
+            self._wake.set()
+        return {"table": name, "rows": len(canon),
+                "generation": new_table.generation,
+                "sealed_generation": new_table.sealed_generation,
+                "delta_rows": new_table.delta_rows,
+                "watermark": new_table.watermark, "wal_seq": seq}
+
+    # ------------------------------------------------- register / replay
+
+    def on_register(self, entry):
+        """register_table hook. A table already live in THIS engine is
+        being REPLACED: its logged appends belonged to the old data —
+        reset the log. A first registration with an existing log is
+        crash RECOVERY: replay to the acknowledged state
+        (cfg.ingest_wal_replay gates it)."""
+        cfg = self.config
+        name = entry.name
+        with self._lock:
+            st_prev = self._states.pop(name, None)
+        if st_prev is not None:
+            self._m_delta.set(0, table=name)
+            wal = st_prev.wal
+            if wal is not None and not wal._closed and not wal.tainted:
+                wal.reset()
+                wal.close()
+                self._m_wal.set(0, table=name)
+            elif cfg.ingest_wal_dir:
+                # no live handle to reset through (never appended, or
+                # closed by Engine.close, or tainted by a failed
+                # write): drop the file itself — the next append
+                # recreates it from seq 0
+                if wal is not None:
+                    wal.close(final_sync=False)
+                try:
+                    os.unlink(wal_path(cfg.ingest_wal_dir, name))
+                except OSError:
+                    pass
+                self._m_wal.set(0, table=name)
+            return
+        if not entry.is_accelerated or name.startswith("__cube_") \
+                or not cfg.ingest_wal_dir or not cfg.ingest_wal_replay:
+            return
+        records = replay_wal(wal_path(cfg.ingest_wal_dir, name))
+        if records:
+            self._replay(entry, records)
+
+    def _replay(self, entry, records):
+        """Apply replayed WAL records as ONE batched extension (the
+        per-append tail-rebuild fill is deterministic, so the batched
+        result is block-identical to the original append sequence).
+        Failure mid-replay restores the clean base snapshot — the
+        table is registered base-only, never half-recovered; a retry
+        (re-registration) replays again."""
+        eng = self.engine
+        cfg = self.config
+        name = entry.name
+        st = self._state(name)
+        base_snapshot = entry.segments
+        t0 = time.perf_counter()
+        try:
+            with st.lock:
+                all_rows: list = []
+                for seq, rows in records:
+                    maybe_inject(cfg, "wal-replay", 0)
+                    all_rows.extend(rows)
+                enc = encode_rows(
+                    entry.segments, all_rows,
+                    require_time=entry.time_column is not None)
+                entry.segments = extend_snapshot(entry.segments, enc)
+                if all_rows:
+                    st.frames.append(self._delta_frame(entry, all_rows))
+                    st.frames_version += 1
+                st.appended_rows += len(all_rows)
+                st.replayed_rows = len(all_rows)
+                st.acked_seq = records[-1][0]
+                entry.delta_source = st.delta_source
+        except Exception:
+            with st.lock:
+                entry.segments = base_snapshot
+                entry.delta_source = None
+            with self._lock:
+                self._states.pop(name, None)
+            raise
+        ms = (time.perf_counter() - t0) * 1000
+        self._m_rows.inc(len(all_rows), table=name)
+        self._m_delta.set(entry.segments.delta_rows, table=name)
+        eng.runner.events.emit(
+            "wal_replay", table=name, records=len(records),
+            rows=len(all_rows), ms=round(ms, 3),
+            generation=entry.segments.generation)
+        if cfg.ingest_auto_compact and entry.segments.delta_rows \
+                >= int(cfg.ingest_compact_rows):
+            self._ensure_compactor()
+            self._wake.set()
+
+    def on_drop(self, name: str):
+        with self._lock:
+            st = self._states.pop(name, None)
+        if st is not None:
+            self._m_delta.set(0, table=name)
+            if st.wal is not None:
+                st.wal.delete()
+                self._m_wal.set(0, table=name)
+
+    # ---------------------------------------------------------- compactor
+
+    def _ensure_compactor(self):
+        if self._stopped or not self.config.ingest_auto_compact:
+            return
+        with self._lock:
+            if self._compactor is not None \
+                    and self._compactor.is_alive():
+                return
+            t = threading.Thread(target=self._compact_loop,
+                                 name="tpu-olap-compactor", daemon=True)
+            self._compactor = t
+            t.start()
+
+    def _compact_loop(self):
+        cfg = self.config
+        while not self._stopped:
+            self._wake.wait(
+                max(0.05, float(cfg.ingest_compact_interval_s)))
+            self._wake.clear()
+            if self._stopped:
+                return
+            with self._lock:
+                names = list(self._states)
+            for name in names:
+                if self._stopped:
+                    return
+                try:
+                    entry = self.engine.catalog.maybe(name)
+                    if entry is None or not entry.is_accelerated:
+                        continue
+                    if entry.segments.delta_rows \
+                            >= int(cfg.ingest_compact_rows):
+                        self.compact_now(name)
+                except QueryShed:
+                    pass     # admission saturated: retry next tick
+                except Exception as e:  # noqa: BLE001 — retried, but
+                    # never silently: a persistently failing compaction
+                    # means the delta grows until every append sheds,
+                    # and the operator needs a visible cause
+                    self._m_compact_err.inc(table=name)
+                    try:
+                        self.engine.runner.events.emit(
+                            "compact_error", table=name,
+                            error=f"{type(e).__name__}: {e}")
+                    except Exception:  # noqa: BLE001
+                        pass
+
+    def compact_now(self, name: str) -> dict | None:
+        """Seal the table's delta (sync spelling; the compactor loop
+        calls this too). The rebuild runs OUTSIDE the ingest lock from
+        an immutable snapshot; appends that race in are carried over
+        as rebased delta blocks in the short swap section. Runs under
+        an admission slot and skips while the breaker is open, so
+        background sealing queues/sheds with foreground traffic
+        instead of around it."""
+        eng = self.engine
+        runner = eng.runner
+        entry = eng.catalog.maybe(name)
+        if entry is None or not entry.is_accelerated:
+            return None
+        st = self._state(name)
+        with st.lock:
+            if st.compacting:
+                return {"table": name, "status": "busy"}
+            snapshot = entry.segments
+            if snapshot.delta_rows == 0:
+                return None
+            st.compacting = True
+        t0 = time.perf_counter()
+        try:
+            if runner.breaker.state == "open":
+                # device sick: don't churn its caches now
+                return {"table": name, "status": "breaker-open"}
+            with runner.admission.slot(None):
+                maybe_inject(self.config, "compact", 0)
+                compacted = compact_table(snapshot)
+            d_snap = snapshot.delta_rows
+            with st.lock:
+                live = entry.segments
+                d_live = live.delta_rows
+                dicts = dict(compacted.dictionaries)
+                blocks: list = []
+                if d_live > d_snap:
+                    # appends raced the rebuild: carry the uncovered
+                    # tail rows over, remapping string codes into the
+                    # compacted (re-sorted, possibly extended) dicts
+                    for c, ld in live.dictionaries.items():
+                        missing = [v for v in ld.values
+                                   if dicts[c].id_of(v) <= 0]
+                        if missing:
+                            dicts[c] = dicts[c].extended(missing)
+                    cols, nulls = _gather_delta_rows(live, d_snap)
+                    for c, typ in live.schema.items():
+                        if typ is ColumnType.STRING:
+                            r = _remap_codes(live.dictionaries[c],
+                                             dicts[c])
+                            cols[c] = r[np.asarray(cols[c], np.int64)] \
+                                .astype(np.int32)
+                    blocks = _emit_blocks(
+                        live.schema, live.block_rows, cols, nulls,
+                        len(compacted.segments))
+                merged = TableSegments(
+                    name, live.schema, dicts,
+                    compacted.segments + blocks, live.block_rows,
+                    sealed_count=len(compacted.segments))
+                merged.time_partition = compacted.time_partition
+                merged.star = snapshot.star
+                entry.segments = merged
+                st.compactions += 1
+                st.last_compact_ms = (time.perf_counter() - t0) * 1000
+                entry._frame_aug = None
+                # consolidate the fallback frames this compaction
+                # sealed into ONE frame (the carried tail stays
+                # per-append): appended rows remain host-resident in
+                # frame form — the fallback path needs them, exactly
+                # as _frame duplicates base rows — but per-append
+                # fragmentation no longer accumulates, so a long
+                # append history costs one frame, not thousands
+                carried = int(d_live - d_snap)
+                keep, acc = [], 0
+                for f in reversed(st.frames):
+                    if acc >= carried:
+                        break
+                    keep.append(f)
+                    acc += len(f)
+                keep.reverse()
+                folded = st.frames[:len(st.frames) - len(keep)]
+                if len(folded) > 1:
+                    import pandas as pd
+                    folded = [pd.concat(folded, ignore_index=True)]
+                st.frames = folded + keep
+                st.frames_version += 1
+            # the sealed set changed: BOTH cache tiers for this table
+            # are stale at key level — purge eagerly; cubes over it are
+            # stale too, the maintainer rebuilds them
+            runner.result_cache.invalidate_table(name)
+            self._m_compact.inc(table=name)
+            self._m_delta.set(merged.delta_rows, table=name)
+            runner.events.emit(
+                "compact", table=name,
+                rows_sealed=compacted.num_rows,
+                delta_rows_folded=d_snap,
+                delta_rows_carried=int(d_live - d_snap),
+                segments=len(compacted.segments),
+                ms=round(st.last_compact_ms, 3),
+                generation=merged.generation,
+                sealed_generation=merged.sealed_generation)
+            eng.cubes.on_table_registered(name)
+            return {"table": name, "status": "compacted",
+                    "rows_sealed": compacted.num_rows,
+                    "delta_rows_folded": d_snap,
+                    "delta_rows_carried": int(d_live - d_snap),
+                    "ms": st.last_compact_ms,
+                    "generation": merged.generation,
+                    "sealed_generation": merged.sealed_generation}
+        finally:
+            with st.lock:
+                st.compacting = False
+
+    def compact_all(self) -> dict:
+        """Compact every table with a non-empty delta (tests, shutdown
+        hygiene). Returns {table: result}."""
+        out = {}
+        with self._lock:
+            names = list(self._states)
+        for name in names:
+            r = self.compact_now(name)
+            if r is not None and r.get("status") == "compacted":
+                out[name] = r
+        return out
+
+    # ------------------------------------------------------------- admin
+
+    def snapshot(self) -> dict:
+        """GET /debug/ingest payload: per-table delta sizes, WAL lag,
+        compactor state."""
+        cfg = self.config
+        eng = self.engine
+        tables = {}
+        with self._lock:
+            states = dict(self._states)
+        for name, st in sorted(states.items()):
+            entry = eng.catalog.maybe(name)
+            if entry is None or not entry.is_accelerated:
+                continue
+            ts = entry.segments
+            wal = None
+            if st.wal is not None:
+                wal = {"path": st.wal.path,
+                       "bytes": st.wal.bytes_written,
+                       "last_seq": st.wal.last_seq,
+                       "synced_seq": st.wal.synced_seq,
+                       "lag_records": st.wal.last_seq
+                       - st.wal.synced_seq}
+            tables[name] = {
+                "delta_rows": ts.delta_rows,
+                "delta_segments": len(ts.segments) - ts.sealed_count,
+                "sealed_segments": ts.sealed_count,
+                "watermark": ts.watermark,
+                "generation": ts.generation,
+                "sealed_generation": ts.sealed_generation,
+                "appended_rows": st.appended_rows,
+                "replayed_rows": st.replayed_rows,
+                "acked_seq": st.acked_seq,
+                "compacting": st.compacting,
+                "compactions": st.compactions,
+                "last_compact_ms": round(st.last_compact_ms, 3),
+                "wal": wal,
+            }
+        return {
+            "tables": tables,
+            "compactor": {
+                "running": self._compactor is not None
+                and self._compactor.is_alive(),
+                "auto": bool(cfg.ingest_auto_compact),
+                "compact_rows": int(cfg.ingest_compact_rows),
+                "interval_s": float(cfg.ingest_compact_interval_s),
+                "max_delta_rows": int(cfg.ingest_max_delta_rows or 0),
+            },
+            "wal": {"dir": cfg.ingest_wal_dir,
+                    "fsync": cfg.ingest_wal_fsync,
+                    "replay_on_register": bool(cfg.ingest_wal_replay)},
+        }
+
+    def stop(self):
+        """Deterministically stop + join the compactor and close every
+        WAL (Engine.close). Appends afterwards reopen WALs lazily; the
+        compactor restarts on the next append that wants it."""
+        self._stopped = True
+        self._wake.set()
+        t = self._compactor
+        joined = True
+        if t is not None:
+            t.join(timeout=10.0)
+            joined = not t.is_alive()
+            if joined:
+                self._compactor = None
+        with self._lock:
+            states = list(self._states.values())
+        for st in states:
+            if st.wal is not None:
+                st.wal.close()
+        if joined:
+            # re-arm: a later append may restart the compactor cleanly.
+            # A join timeout (compaction wedged mid-rebuild) keeps the
+            # stop flag set so the straggler exits at its next check
+            # instead of being revived as a zombie.
+            self._stopped = False

@@ -17,13 +17,33 @@ NULL_ID = 0
 
 
 class Dictionary:
-    __slots__ = ("values", "_index", "_value_hash_table")
+    __slots__ = ("values", "is_sorted", "_index", "_value_hash_table")
 
-    def __init__(self, values: np.ndarray):
-        """values: sorted unique string array (no nulls)."""
+    def __init__(self, values: np.ndarray, is_sorted: bool = True):
+        """values: unique string array (no nulls). Batch ingest always
+        builds sorted values (`is_sorted=True`, the fast-filter
+        invariant); real-time appends EXTEND a dictionary by appending
+        unseen values at the tail (`extended`), which may leave it
+        unsorted until compaction re-sorts — bound filters then fall
+        back from code-range compares to predicate tables
+        (kernels.filtereval; docs/INGEST.md)."""
         self.values = values
+        self.is_sorted = bool(is_sorted)
         self._index = None  # lazy value -> id dict
         self._value_hash_table = None  # memoized crc32 table (kernels)
+
+    def extended(self, new_values) -> "Dictionary":
+        """New Dictionary with `new_values` (unseen, in order) appended
+        at the tail — existing codes stay stable, so sealed segments and
+        their cached partials remain valid across the extension."""
+        if not len(new_values):
+            return self
+        tail = np.asarray(new_values, dtype=str)
+        cat = np.concatenate([np.asarray(self.values, dtype=str), tail])
+        still = self.is_sorted and bool(
+            np.all(cat[max(0, len(self.values) - 1):][:-1]
+                   <= cat[max(0, len(self.values) - 1):][1:]))
+        return Dictionary(cat, is_sorted=still)
 
     @staticmethod
     def build(arr) -> tuple["Dictionary", np.ndarray]:

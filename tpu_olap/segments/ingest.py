@@ -550,8 +550,12 @@ class StreamIngestor:
                     v = r[v]
                 s.columns[c] = v.astype(dt, copy=False)
 
-        return TableSegments(self.name, self.schema, dictionaries,
-                             self._segments, self.block_rows)
+        out = TableSegments(self.name, self.schema, dictionaries,
+                            self._segments, self.block_rows)
+        # recorded so delta compaction re-partitions the same way
+        # (segments/delta.py; docs/INGEST.md)
+        out.time_partition = self.time_partition
+        return out
 
 
 # --------------------------------------------------------------------------

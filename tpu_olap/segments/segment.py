@@ -79,26 +79,103 @@ class Segment:
 
 
 class TableSegments:
-    """All segments of one registered datasource + shared metadata."""
+    """All segments of one registered datasource + shared metadata.
+
+    Segment scopes (docs/INGEST.md): `segments[:sealed_count]` are the
+    SEALED store (built by batch ingest or compaction, immutable,
+    time-partitioned); anything past it is the mutable table's DELTA —
+    frozen append blocks the real-time ingest path swaps in. Two
+    generations track the two scopes: `generation` moves on EVERY
+    snapshot construction (appends included) and keys whole-result
+    state (the tier-2 full-result cache), while `sealed_generation`
+    moves only when the sealed set itself changes (registration,
+    compaction) — so per-sealed-segment partial-aggregate cache entries
+    and materialized cubes survive delta-only appends."""
 
     def __init__(self, name: str, schema: dict, dictionaries: dict,
-                 segments: list, block_rows: int):
+                 segments: list, block_rows: int,
+                 sealed_count: int | None = None,
+                 sealed_generation: int | None = None):
         self.name = name
         self.schema = schema            # col -> ColumnType (incl. __time)
         self.dictionaries = dictionaries  # col -> Dictionary (STRING cols)
         self.segments = segments        # list[Segment], time-ordered
         self.block_rows = block_rows
         # ingest generation: part of every semantic-cache key, bumped by
-        # construction (each ingest/re-registration builds a fresh
-        # TableSegments), so cached results can never outlive the data
-        # they were computed from (docs/CACHING.md)
+        # construction (each ingest/re-registration/append builds a
+        # fresh TableSegments), so cached results can never outlive the
+        # data they were computed from (docs/CACHING.md)
         self.generation = next_table_generation(name)
+        self.sealed_count = len(segments) if sealed_count is None \
+            else int(sealed_count)
+        # sealed-scope generation: defaults to this snapshot's own
+        # generation (a fresh registration/compaction IS a new sealed
+        # set); delta-only append snapshots carry the predecessor's
+        self.sealed_generation = self.generation \
+            if sealed_generation is None else int(sealed_generation)
+        # resolved time-partition granularity ("day"/"month"/"year" or
+        # None), recorded so compaction re-partitions the same way
+        self.time_partition = None
         # declared star schema (set at registration when provided):
         # lowering consults its functional dependencies for data-derived
         # dimension-domain restriction (filter on a dependent column
         # shrinking a grouped determinant's dense id space)
         self.star = None
         self._fd_code_maps: dict = {}
+
+    # ---- segment scopes (real-time ingest; docs/INGEST.md) ---------------
+
+    def segment_sealed(self, sid: int) -> bool:
+        return sid < self.sealed_count
+
+    def segment_generation(self, sid: int) -> int:
+        """Cache-scope generation of one segment: sealed segments share
+        `sealed_generation` (stable across delta-only appends), delta
+        blocks take the snapshot generation (every append re-keys them
+        — their contents change block-in-place across snapshots)."""
+        return self.sealed_generation if sid < self.sealed_count \
+            else self.generation
+
+    def delta_ids(self) -> list:
+        return list(range(self.sealed_count, len(self.segments)))
+
+    @property
+    def delta_rows(self) -> int:
+        return sum(s.meta.n_valid
+                   for s in self.segments[self.sealed_count:])
+
+    @property
+    def watermark(self) -> int:
+        """Max __time over the SEALED scope (0 when empty) — the
+        boundary below which cube builds and sealed cache partials are
+        complete; delta rows may carry any timestamp and are folded
+        through the base path at serve time."""
+        sealed = self.segments[:self.sealed_count]
+        return max((s.meta.time_max for s in sealed if s.meta.n_valid),
+                   default=0)
+
+    def sealed_view(self) -> "TableSegments":
+        """A sealed-scope snapshot of this table: `self` when there is
+        no delta; otherwise a derived TableSegments sharing the sealed
+        segment objects and dictionaries, with BOTH generations pinned
+        to `sealed_generation` (the view is the sealed set — cube
+        builds run against it so their partials never swallow delta
+        rows the compactor would later re-deliver)."""
+        if self.sealed_count >= len(self.segments):
+            return self
+        view = TableSegments.__new__(TableSegments)
+        view.name = self.name
+        view.schema = self.schema
+        view.dictionaries = self.dictionaries
+        view.segments = self.segments[:self.sealed_count]
+        view.block_rows = self.block_rows
+        view.generation = self.sealed_generation
+        view.sealed_count = self.sealed_count
+        view.sealed_generation = self.sealed_generation
+        view.time_partition = self.time_partition
+        view.star = self.star
+        view._fd_code_maps = {}
+        return view
 
     def fd_code_map(self, det: str, dep: str):
         """[det_codes+?] -> dep code map derived from the data (0 where
