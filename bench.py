@@ -923,7 +923,12 @@ def _ingest_main() -> int:
        interference the enqueue-only dispatch lock is supposed to
        bound;
     4. CRASH RECOVERY: a fresh engine re-registers the base and
-       replays the WAL — replay wall + rows/s, then compaction wall.
+       replays the WAL — replay wall + rows/s, then compaction wall;
+    5. CHECKPOINTED RECOVERY (docs/DURABILITY.md): checkpoint the
+       recovered table (seal + spill + manifest + WAL truncation),
+       append a small tail, crash again — the restart must replay
+       ONLY the tail, so its replay cost is independent of the
+       pre-checkpoint append volume (banked as frames full vs tail).
 
     Parity: the final recovered state must be sha256-identical to a
     one-shot registration of base + every acknowledged batch."""
@@ -955,8 +960,14 @@ def _ingest_main() -> int:
         "v": rng.integers(0, 10_000, base_rows).astype(np.int64),
     })
     wal_dir = tempfile.mkdtemp(prefix="bench-ingest-wal-")
+    store_dir = tempfile.mkdtemp(prefix="bench-ingest-store-")
+    # checkpoint_on_compact stays OFF so phases 1-4 measure the pure
+    # WAL-replay path (the honest O(total) baseline phase 5 is
+    # compared against); phase 5 checkpoints explicitly
     mk_cfg = lambda: EngineConfig(  # noqa: E731
         ingest_wal_dir=wal_dir, ingest_wal_fsync=fsync,
+        ingest_store_dir=store_dir,
+        ingest_store_checkpoint_on_compact=False,
         ingest_compact_rows=1 << 15, ingest_compact_interval_s=0.25,
         history_limit=1_000_000)
     eng = Engine(mk_cfg())
@@ -1067,8 +1078,43 @@ def _ingest_main() -> int:
         f.to_csv(index=False).encode()).hexdigest()
     parity_ok = dig(rec.sql(q)) == dig(ref.sql(q))
     note(f"recovery parity: {parity_ok}")
+
+    # --- phase 5: checkpointed recovery (docs/DURABILITY.md) — the
+    # same table, but with a durable checkpoint between the appends
+    # and the crash: replay cost must drop from O(total appends) to
+    # O(tail), independent of the pre-checkpoint volume
+    full_replay_frames = ev[0]["records"] if ev else 0
+    t0 = time.perf_counter()
+    ck = rec.checkpoint_now("events")
+    checkpoint_s = time.perf_counter() - t0
+    tail_batches = 5
+    for j in range(tail_batches):
+        rec.append("events", mk_batch(900_000 + j))
+    dig_before = dig(rec.sql(q))
     rec.close()
+    t0 = time.perf_counter()
+    rec2 = Engine(mk_cfg())
+    rec2.config.ingest_auto_compact = False
+    rec2.register_table("events", base, time_column="ts",
+                        block_rows=1 << 14, time_partition="month")
+    recover_ck_wall = time.perf_counter() - t0
+    ev2 = [e for e in rec2.runner.events.snapshot()
+           if e["event"] == "wal_replay"]
+    loads = [e for e in rec2.runner.events.snapshot()
+             if e["event"] == "store_load"]
+    tail_frames = ev2[0]["records"] if ev2 else 0
+    tail_replay_ms = ev2[0]["ms"] if ev2 else 0.0
+    ck_parity_ok = dig(rec2.sql(q)) == dig_before
+    note(f"checkpointed recovery: checkpoint {checkpoint_s:.2f}s "
+         f"({ck.get('bytes', 0)} bytes, status {ck.get('status')}), "
+         f"restart replayed {tail_frames} frames (full replay was "
+         f"{full_replay_frames}) in {tail_replay_ms:.0f} ms; "
+         f"parity {ck_parity_ok}")
+    parity_ok = parity_ok and ck_parity_ok and bool(loads) \
+        and tail_frames == tail_batches
+    rec2.close()
     shutil.rmtree(wal_dir, ignore_errors=True)
+    shutil.rmtree(store_dir, ignore_errors=True)
 
     out = {
         "metric": "ingest_append_rows_per_s",
@@ -1089,6 +1135,21 @@ def _ingest_main() -> int:
                 "replay_rows_per_s": round(
                     replay_rows / max(replay_ms / 1000, 1e-6), 1),
                 "compact_s": round(compact_s, 3)},
+            # docs/DURABILITY.md: replay cost with a checkpoint on
+            # disk is O(tail) — frames_replayed_tail vs
+            # frames_replayed_full is the independence-from-volume
+            # evidence (the tail is a fixed 5 batches regardless of
+            # how much was appended before the checkpoint)
+            "checkpointed_recovery": {
+                "checkpoint_s": round(checkpoint_s, 3),
+                "checkpoint_bytes": ck.get("bytes"),
+                "wal_frames_truncated": ck.get(
+                    "wal_frames_truncated"),
+                "register_plus_replay_s": round(recover_ck_wall, 3),
+                "replay_ms": tail_replay_ms,
+                "frames_replayed_tail": tail_frames,
+                "frames_replayed_full": full_replay_frames,
+                "parity_ok": ck_parity_ok},
             "compactions": snap["compactions"],
             "wal_bytes_final": (snap["wal"] or {}).get("bytes"),
             "parity_ok": parity_ok,

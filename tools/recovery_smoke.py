@@ -1,0 +1,232 @@
+"""Durable-store recovery smoke (ISSUE 14 satellite; the
+`recovery-smoke` CI job in .github/workflows/tier1.yml — the
+checkpointed extension of tools/ingest_smoke.py).
+
+End-to-end checkpoint + crash-recovery contract, seconds-scale:
+
+1. a CHILD process registers a deterministic base with WAL + segment
+   store, appends batches, runs `CHECKPOINT DRUID TABLE` (seal ->
+   spill -> manifest advance -> WAL truncation), appends MORE batches,
+   reports progress on stdout, then SIGKILLs itself — a real crash
+   with a checkpoint on disk and a live WAL tail;
+2. the parent recovers over the same directories and verifies
+   TAIL-ONLY replay: the newest verifiable manifest restores the
+   sealed scope and the wal_replay event's record count must equal
+   only the post-checkpoint appends (O(tail), NOT O(total));
+3. query results must be sha256-identical to a one-shot registration
+   of base + every acknowledged batch;
+4. a CORRUPTED-CHUNK run: flip one byte in a chunk file unique to the
+   newest manifest and recover again — the ladder must detect it
+   (store_fallback), fall back to the previous manifest + the lag-one
+   WAL tail, and STILL reach sha256 parity. Never a wrong answer.
+
+Exit 0 on success, 1 on any violation.
+"""
+
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+N_BASE = 2000
+PRE_BATCHES = 8          # acknowledged before the checkpoints
+POST_BATCHES = 3         # the WAL tail the crash leaves behind
+ROWS_PER_BATCH = 3
+BLOCK = 512
+
+QUERIES = [
+    "SELECT g, count(*) AS n, sum(v) AS s FROM t GROUP BY g ORDER BY g",
+    "SELECT month(ts) AS mo, sum(v) AS s, min(v) AS lo FROM t "
+    "GROUP BY month(ts) ORDER BY mo",
+    "SELECT count(*) AS n, sum(v) AS s FROM t WHERE v < 500",
+]
+
+
+def base_frame():
+    import numpy as np
+    import pandas as pd
+    rng = np.random.default_rng(42)
+    return pd.DataFrame({
+        "ts": pd.to_datetime("2022-03-01")
+        + pd.to_timedelta(rng.integers(0, 86400 * 45, N_BASE),
+                          unit="s"),
+        "g": rng.choice([f"g{i}" for i in range(8)], N_BASE),
+        "v": rng.integers(0, 1000, N_BASE).astype(np.int64),
+    })
+
+
+def batch(i):
+    return [{"ts": f"2022-05-{10 + (i % 15):02d}T00:00:0{j}",
+             "g": f"s{i % 3}", "v": i * 10 + j}
+            for j in range(ROWS_PER_BATCH)]
+
+
+def digest(frame):
+    return hashlib.sha256(frame.to_csv(index=False).encode()) \
+        .hexdigest()
+
+
+def make_engine(root):
+    from tpu_olap import Engine
+    from tpu_olap.executor import EngineConfig
+    eng = Engine(EngineConfig(
+        ingest_wal_dir=os.path.join(root, "wal"),
+        ingest_store_dir=os.path.join(root, "store"),
+        ingest_auto_compact=False))
+    eng.register_table("t", base_frame(), time_column="ts",
+                       block_rows=BLOCK, time_partition="month")
+    return eng
+
+
+def child_main(root):
+    eng = make_engine(root)
+    # two checkpoints so the second TRUNCATES the WAL through the
+    # first's watermark (lag-one) — the crash must prove the truncated
+    # log plus the manifest still cover every acknowledged row
+    half = PRE_BATCHES // 2
+    for i in range(half):
+        eng.append("t", batch(i))
+    ck1 = eng.checkpoint_now("t")
+    assert ck1["status"] == "checkpointed", ck1
+    for i in range(half, PRE_BATCHES):
+        eng.append("t", batch(i))
+    ck2 = eng.checkpoint_now("t")
+    assert ck2["status"] == "checkpointed", ck2
+    assert ck2["wal_frames_truncated"] == half, ck2
+    for i in range(PRE_BATCHES, PRE_BATCHES + POST_BATCHES):
+        eng.append("t", batch(i))
+    n = int(eng.sql("SELECT count(*) AS n FROM t")["n"][0])
+    total = (PRE_BATCHES + POST_BATCHES) * ROWS_PER_BATCH
+    assert n == N_BASE + total, f"visibility: {n}"
+    print(json.dumps({"acked_batches": PRE_BATCHES + POST_BATCHES,
+                      "acked_rows": total,
+                      "checkpoint_id": ck2["checkpoint_id"],
+                      "wal_frames_truncated":
+                          ck2["wal_frames_truncated"],
+                      "visible": n}), flush=True)
+    os.kill(os.getpid(), signal.SIGKILL)  # the real thing
+
+
+def recover_and_check(root, ref, label, expect_tail,
+                      expect_fallback=False):
+    eng = make_engine(root)
+    events = eng.runner.events.snapshot()
+    loads = [e for e in events if e["event"] == "store_load"]
+    replays = [e for e in events if e["event"] == "wal_replay"]
+    falls = [e for e in events if e["event"] == "store_fallback"]
+    if not loads:
+        print(f"FAIL[{label}]: no store_load event — the checkpoint "
+              "was not used")
+        return None
+    if expect_fallback and not falls:
+        print(f"FAIL[{label}]: corruption was not detected (no "
+              "store_fallback event)")
+        return None
+    if not expect_fallback and falls:
+        print(f"FAIL[{label}]: unexpected fallbacks: {falls}")
+        return None
+    replayed = replays[0]["records"] if replays else 0
+    total = PRE_BATCHES + POST_BATCHES
+    if replayed != expect_tail:
+        print(f"FAIL[{label}]: replayed {replayed} frames, expected "
+              f"the {expect_tail}-frame tail (of {total} total "
+              "appends)")
+        return None
+    print(f"[{label}] store_load ck={loads[0]['checkpoint_id']} "
+          f"wal_seq={loads[0]['wal_seq']}; replayed {replayed}/"
+          f"{total} frames (tail-only), fallbacks={len(falls)}")
+    for q in QUERIES:
+        if digest(eng.sql(q)) != digest(ref.sql(q)):
+            print(f"FAIL[{label}]: parity: {q}")
+            return None
+    print(f"[{label}] sha256 parity: OK")
+    return eng
+
+
+def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child_main(sys.argv[2])
+        return 1  # unreachable
+
+    root = tempfile.mkdtemp(prefix="recovery-smoke-")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", root],
+        capture_output=True, text=True, env=env, timeout=300)
+    if proc.returncode != -signal.SIGKILL:
+        print(f"FAIL: child exited {proc.returncode}, expected "
+              f"SIGKILL\nstdout: {proc.stdout}\nstderr: {proc.stderr}")
+        return 1
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    print(f"child: acked {report['acked_rows']} rows over "
+          f"{report['acked_batches']} batches, checkpoint "
+          f"#{report['checkpoint_id']} truncated "
+          f"{report['wal_frames_truncated']} WAL frames, then SIGKILL")
+
+    # never-crashed oracle: one-shot registration of base + everything
+    import pandas as pd
+    from tpu_olap import Engine
+    extra = pd.DataFrame(
+        [r for i in range(PRE_BATCHES + POST_BATCHES)
+         for r in batch(i)])
+    extra["ts"] = pd.to_datetime(extra["ts"])
+    ref = Engine()
+    ref.register_table("t", pd.concat([base_frame(), extra],
+                                      ignore_index=True),
+                       time_column="ts", block_rows=BLOCK,
+                       time_partition="month")
+
+    # --- run 1: clean recovery must be tail-only
+    eng = recover_and_check(root, ref, "clean", POST_BATCHES)
+    if eng is None:
+        return 1
+    eng.close()
+
+    # --- run 2: corrupt one chunk unique to the NEWEST manifest; the
+    # ladder falls back to the previous manifest + the lag-one WAL
+    # tail (which still holds the second half of the pre-crash
+    # appends) and parity must hold
+    d = os.path.join(root, "store", "t")
+    manifests = sorted(n for n in os.listdir(d)
+                       if n.startswith("manifest-"))
+
+    def refs(mf):
+        with open(os.path.join(d, mf), "rb") as f:
+            p = json.load(f)["payload"]
+        return {e["file"] for e in p["segments"]} \
+            | {p["dictionary"]["file"]}
+
+    only_newest = sorted(refs(manifests[-1]) - refs(manifests[0]))
+    if not only_newest:
+        print("FAIL: newest checkpoint wrote no fresh chunk to "
+              "corrupt")
+        return 1
+    target = os.path.join(d, only_newest[0])
+    with open(target, "r+b") as f:
+        f.seek(os.path.getsize(target) // 2)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0x55]))
+    print(f"corrupted {only_newest[0]} (one-byte flip)")
+    # fallback rung covers batches half..end: tail past ck1 watermark
+    tail2 = PRE_BATCHES - PRE_BATCHES // 2 + POST_BATCHES
+    eng = recover_and_check(root, ref, "corrupted-chunk", tail2,
+                            expect_fallback=True)
+    if eng is None:
+        return 1
+    eng.close()
+
+    import shutil
+    shutil.rmtree(root, ignore_errors=True)
+    print("recovery smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

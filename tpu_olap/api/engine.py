@@ -269,6 +269,19 @@ class Engine:
             return self.ingest.compact_all()
         return self.ingest.compact_now(table)
 
+    def checkpoint_now(self, table: str | None = None):
+        """Durably checkpoint a table's sealed scope
+        (docs/DURABILITY.md): compact the delta, spill the sealed
+        segments as checksummed chunk files under
+        `EngineConfig.ingest_store_dir`, atomically advance the
+        checkpoint manifest, and truncate the WAL through the lag-one
+        watermark — after which a process restart replays only the
+        post-checkpoint tail. SQL spelling: ``CHECKPOINT DRUID TABLE
+        t``. `table=None` checkpoints every table with ingest state."""
+        if table is None:
+            return self.ingest.checkpoint_all()
+        return self.ingest.checkpoint_now(table)
+
     def close(self):
         """Deterministically stop and JOIN every background thread the
         engine owns — the compactor and WAL flushers (ingest.stop) and
@@ -917,12 +930,16 @@ _REFRESH_CUBES_RE = _re.compile(
     r"^\s*refresh\s+druid\s+cubes\s*;?\s*$", _re.I)
 # real-time ingest verbs (docs/INGEST.md): INSERT INTO t (a, b) VALUES
 # (...), (...); COMPACT DRUID TABLE t — the SQL spellings of
-# Engine.append / Engine.compact_now
+# Engine.append / Engine.compact_now; CHECKPOINT DRUID TABLE t spills
+# the sealed scope to the durable segment store and truncates the WAL
+# (Engine.checkpoint_now; docs/DURABILITY.md)
 _INSERT_RE = _re.compile(
     r"^\s*insert\s+into\s+(\w+)\s*\(([^)]*)\)\s*values\s*(.+?)\s*;?\s*$",
     _re.I | _re.S)
 _COMPACT_RE = _re.compile(
     r"^\s*compact\s+druid\s+table\s+(\w+)\s*;?\s*$", _re.I)
+_CHECKPOINT_RE = _re.compile(
+    r"^\s*checkpoint\s+druid\s+table\s+(\w+)\s*;?\s*$", _re.I)
 # cheap pre-parse hint that a statement MIGHT reference a sys.* virtual
 # datasource (catalog.systables): a match still confirms against the
 # parsed tree before taking the introspection path
@@ -975,6 +992,10 @@ def _match_verb(query: str):
     if m:
         table = m.group(1)
         return lambda eng: _run_compact(eng, table)
+    m = _CHECKPOINT_RE.match(query)
+    if m:
+        table = m.group(1)
+        return lambda eng: _run_checkpoint(eng, table)
     return None
 
 
@@ -1218,6 +1239,24 @@ def _run_compact(eng: Engine, table: str) -> pd.DataFrame:
         "table": table, "status": "compacted",
         "rows_sealed": res["rows_sealed"],
         "ms": round(res["ms"], 3)}])
+
+
+def _run_checkpoint(eng: Engine, table: str) -> pd.DataFrame:
+    """CHECKPOINT DRUID TABLE t (docs/DURABILITY.md): compact + spill
+    + manifest advance + WAL truncation, reported honestly — `status`
+    is `checkpointed`, `noop` (sealed scope unchanged since the last
+    manifest), `busy`, `no-store` (ingest_store_dir unset), or `error`
+    (from the compaction's auto-hook)."""
+    res = eng.checkpoint_now(table)
+    return pd.DataFrame([{
+        "table": table, "status": res.get("status"),
+        "checkpoint_id": res.get("checkpoint_id"),
+        "segments": res.get("segments"),
+        "files_written": res.get("files_written"),
+        "chunks_reused": res.get("chunks_reused"),
+        "bytes": res.get("bytes"),
+        "wal_frames_truncated": res.get("wal_frames_truncated"),
+        "ms": round(res.get("ms") or 0.0, 3)}])
 
 
 def _run_clear(eng: Engine, table: str | None) -> pd.DataFrame:

@@ -19,7 +19,11 @@ equivalent is a JSON-over-HTTP surface (stdlib only, no new deps):
                      queryable immediately, WAL-durable before the 200,
                      and a full delta sheds with 429 + Retry-After
   GET  /debug/ingest real-time ingest state: per-table delta sizes,
-                     watermarks, WAL bytes/lag, compactor state
+                     watermarks, WAL bytes/lag, compactor state, the
+                     measured drain rate behind 429 Retry-After, and
+                     durable-checkpoint store stats (manifest id, WAL
+                     watermark, spilled bytes — docs/DURABILITY.md;
+                     the SQL spelling is SELECT * FROM sys.checkpoints)
   GET  /status       engine + per-table summary + counters
   GET  /status/metadata/<table>  column metadata (segmentMetadata shape)
   GET  /metrics      Prometheus text exposition (tpu_olap.obs.metrics:

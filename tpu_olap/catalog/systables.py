@@ -24,6 +24,9 @@ Datasources (column tables in docs/OBSERVABILITY.md):
   sys.cubes            materialized rollup cubes: dims/grain/rows,
                        base-vs-cube generation (stale detection),
                        build cost, rewrite serve counts (docs/CUBES.md)
+  sys.checkpoints      durable sealed-segment checkpoints per table:
+                       manifest id, WAL watermark vs acked seq, spilled
+                       bytes, chunk reuse (docs/DURABILITY.md)
 """
 
 from __future__ import annotations
@@ -199,6 +202,22 @@ def _cubes_frame(engine) -> pd.DataFrame:
                         columns=list(_CUBE_COLS))
 
 
+_CHECKPOINT_COLS = (
+    "table", "checkpoint_id", "wal_watermark", "sealed_through_seq",
+    "acked_seq", "checkpoints", "segments", "bytes", "chunks_reused",
+    "manifests_retained", "last_status")
+
+
+def _checkpoints_frame(engine) -> pd.DataFrame:
+    """sys.checkpoints: the durable segment store (segments/store.py;
+    docs/DURABILITY.md) — per table: the newest manifest's id and WAL
+    watermark (frames past it replay at recovery; frames at or below
+    the LAG-ONE watermark are truncated), spilled bytes, and how many
+    chunk files the last checkpoint reused instead of rewriting."""
+    return pd.DataFrame(engine.ingest.store_rows(),
+                        columns=list(_CHECKPOINT_COLS))
+
+
 def _caches_frame(engine) -> pd.DataFrame:
     runner = engine.runner
     snap = runner.result_cache.snapshot()
@@ -238,6 +257,7 @@ class SysTableProvider:
         "sys.metrics": _metrics_frame,
         "sys.caches": _caches_frame,
         "sys.cubes": _cubes_frame,
+        "sys.checkpoints": _checkpoints_frame,
     }
 
     def __init__(self, engine):

@@ -212,6 +212,26 @@ class EngineConfig:
     ingest_auto_compact: bool = True
     ingest_compact_rows: int = 1 << 16
     ingest_compact_interval_s: float = 2.0
+    # --- durable sealed-segment store (segments/store.py;
+    # docs/DURABILITY.md) --- checkpointed spill of the sealed scope as
+    # checksummed columnar chunk files plus an atomically-swapped
+    # manifest, so recovery replays only the WAL tail past the
+    # checkpoint watermark instead of the whole append history.
+    # ingest_store_dir: directory for per-table checkpoint stores; None
+    # disables checkpointing (recovery replays the full WAL, the PR 13
+    # behavior).
+    ingest_store_dir: str | None = None
+    # manifests retained per table (>= 2). The WAL truncates only
+    # through the watermark of the OLDEST retained manifest (lag-one),
+    # so a corrupt newest checkpoint always falls back to the previous
+    # one with the covering WAL tail still on disk — a single corrupt
+    # chunk or torn manifest never loses an acknowledged row.
+    ingest_store_keep_manifests: int = 2
+    # checkpoint automatically after every compaction (the durability
+    # hook: seal -> spill -> manifest advance -> WAL truncate). False =
+    # checkpoint only via Engine.checkpoint_now / CHECKPOINT DRUID
+    # TABLE (deterministic for tests/benches).
+    ingest_store_checkpoint_on_compact: bool = True
 
     # execution platform: "device" = default jax backend, "cpu" = numpy path
     platform: str = "device"

@@ -18,6 +18,18 @@ resilience layer must survive, not just the device dispatch:
                    re-registration replays again)
     compact        the background compactor before the sealed-set swap
                    (a crashed compaction leaves the delta intact)
+    spill-write    segments/store.py before any chunk file is written
+                   (a crashed spill leaves at most orphan chunks; the
+                   manifest — and therefore recovery — is unchanged)
+    manifest-swap  before the checkpoint manifest's atomic rename (the
+                   spilled chunks exist but the previous manifest stays
+                   authoritative; the WAL is not truncated)
+    store-load     Engine.register_table before the store's recovery
+                   ladder runs (a crash mid-recovery aborts the
+                   registration; a retry loads the store again)
+    wal-truncate   after the manifest swap, before the WAL rewrite (the
+                   log keeps pre-checkpoint frames; replay filters them
+                   by the manifest watermark)
 
 Backwards compatibility: a plain callable (no ``stages`` attribute)
 fires ONLY at the classic ``dispatch`` site, exactly as before — every
@@ -38,7 +50,8 @@ LEGACY_STAGES = ("dispatch",)
 
 ALL_STAGES = ("dispatch", "host-transfer", "reprobe", "ingest",
               "batch-leg", "append", "wal-write", "wal-replay",
-              "compact")
+              "compact", "spill-write", "manifest-swap", "store-load",
+              "wal-truncate")
 
 
 def maybe_inject(config, stage: str, attempt: int = 0) -> None:

@@ -28,11 +28,16 @@ fsync policy (`EngineConfig.ingest_wal_fsync`):
               `GET /debug/ingest` shows the lag)
   "never"     no fsync (tests/benchmarks; OS-crash durability only)
 
-The log is the SOLE durable copy of appended rows: compaction folds
-delta rows into in-memory sealed segments but never truncates the log
-(the sealed store is not persisted), so recovery cost grows with total
-appended rows until the table is re-registered with fresh data — which
-resets the log (`WriteAheadLog.reset`).
+With the durable sealed-segment store disabled (no
+`EngineConfig.ingest_store_dir`) the log is the SOLE durable copy of
+appended rows, so recovery cost grows with total appended rows until
+the table is re-registered with fresh data — which resets the log
+(`WriteAheadLog.reset`). With the store enabled (segments/store.py;
+docs/DURABILITY.md), a checkpoint spills the sealed scope and then
+`truncate_through(seq)` drops the frames the checkpoint covers, so the
+log keeps only the post-checkpoint tail and recovery is O(tail):
+replay loads the newest verifiable manifest and applies only frames
+past its watermark.
 """
 
 from __future__ import annotations
@@ -49,7 +54,8 @@ _HEADER = struct.Struct("<II")  # payload length, crc32(payload)
 # make the reader allocate gigabytes before the CRC check can fail
 MAX_FRAME_BYTES = 256 << 20
 
-__all__ = ["WriteAheadLog", "replay_wal", "wal_path"]
+__all__ = ["WriteAheadLog", "replay_wal", "truncate_file_through",
+           "wal_path"]
 
 
 def wal_path(wal_dir: str, table: str) -> str:
@@ -96,6 +102,80 @@ def replay_wal(path: str):
         with open(path, "r+b") as f:
             f.truncate(good_end)
     return out
+
+
+def _split_frames(path: str, through_seq: int) -> tuple[bytes, int]:
+    """Raw bytes of every intact frame with seq > `through_seq`, plus
+    the count of intact frames dropped. Kept frames are copied verbatim
+    (headers + payloads untouched) so their CRCs stay valid; parsing
+    stops at the first torn/corrupt frame like `replay_wal` and the
+    garbage tail is dropped with the covered prefix."""
+    kept = bytearray()
+    dropped = 0
+    with open(path, "rb") as f:
+        while True:
+            head = f.read(_HEADER.size)
+            if len(head) < _HEADER.size:
+                break
+            length, crc = _HEADER.unpack(head)
+            if length > MAX_FRAME_BYTES:
+                break
+            payload = f.read(length)
+            if len(payload) < length or zlib.crc32(payload) != crc:
+                break
+            try:
+                seq = int(json.loads(payload.decode("utf-8"))["seq"])
+            except Exception:  # noqa: BLE001 — corrupt frame = torn tail
+                break
+            if seq > through_seq:
+                kept += head + payload
+            else:
+                dropped += 1
+    return bytes(kept), dropped
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync the directory so a rename inside it is durable (best
+    effort: some filesystems refuse directory fds)."""
+    try:
+        fd = os.open(path or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _atomic_rewrite(path: str, kept: bytes, do_fsync: bool) -> None:
+    """The crash-safe truncation rewrite both truncation paths share:
+    kept tail -> temp file -> (fsync) -> rename over the log ->
+    directory fsync. A crash at any point leaves either the old or
+    the new file, both of which replay correctly against the
+    checkpoint watermark."""
+    tmp = path + ".trunc"
+    with open(tmp, "wb") as f:
+        f.write(kept)
+        f.flush()
+        if do_fsync:
+            os.fsync(f.fileno())
+    os.rename(tmp, path)
+    _fsync_dir(os.path.dirname(path))
+
+
+def truncate_file_through(path: str, through_seq: int) -> int:
+    """Atomically drop frames with seq <= `through_seq` from a log file
+    with NO live handle (recovery housekeeping, closed engines).
+    Returns the number of frames dropped; missing file -> 0."""
+    if through_seq <= 0 or not os.path.exists(path):
+        return 0
+    kept, dropped = _split_frames(path, through_seq)
+    if dropped == 0:
+        return 0
+    _atomic_rewrite(path, kept, do_fsync=True)
+    return dropped
 
 
 class WriteAheadLog:
@@ -204,6 +284,38 @@ class WriteAheadLog:
                         self._synced_seq = self._seq
                     except (OSError, ValueError):
                         pass  # retried next tick; synced_seq shows lag
+
+    def truncate_through(self, through_seq: int) -> int:
+        """Atomically drop frames with seq <= `through_seq` — they are
+        covered by a durable sealed-segment checkpoint (the caller
+        advances the manifest FIRST; docs/DURABILITY.md). The rewrite
+        is temp-write -> fsync -> rename, so a crash mid-truncate
+        leaves either the full or the truncated log; both replay
+        correctly because recovery filters frames by the checkpoint
+        watermark. Returns the number of frames dropped. seq counters
+        (`last_seq`/`synced_seq`) are untouched: truncation never
+        un-acknowledges anything."""
+        if through_seq <= 0:
+            return 0
+        with self._lock:
+            if self._closed:
+                raise RuntimeError(f"WAL {self.path} is closed")
+            if self.tainted:
+                raise RuntimeError(
+                    f"WAL {self.path} is tainted; re-register the "
+                    "table to reset it")
+            self._f.flush()
+            kept, dropped = _split_frames(self.path, through_seq)
+            if dropped == 0:
+                return 0
+            # close the append handle BEFORE the rename so no buffered
+            # residue can land in the replaced file afterwards
+            self._f.close()
+            _atomic_rewrite(self.path, kept,
+                            do_fsync=self.fsync_mode != "never")
+            self._f = open(self.path, "ab")
+            self.bytes_written = len(kept)
+            return dropped
 
     # ------------------------------------------------------------- admin
 
