@@ -1,5 +1,6 @@
 """Cost model (planner.cost): the DruidQueryCostModel analog — strategy
-choice between explicit shard_map partials ("historicals") and
+choice between sharded per-chip partials + host broker merge
+("historicals") and
 whole-program GSPMD ("broker"), and its integration into execution and
 EXPLAIN (SURVEY.md §3.2, §6)."""
 

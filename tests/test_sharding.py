@@ -97,3 +97,134 @@ def test_sharded_pruning_still_correct():
     # failure) records the fallback execution after the device attempt
     m = [h for h in e8.runner.history if "segments_total" in h][-1]
     assert m["segments_scanned"] < m["segments_total"]
+
+
+# ---------------------------------------------------------------------------
+# jit + NamedSharding rebuild (ISSUE 15): interleaved placement, per-chip
+# windows, broker merge, cache shards, sys.devices, incremental re-place
+
+
+def test_interleaved_placement_perms():
+    """placement(): chip-major placed order, logical i on chip i mod D,
+    and the two permutations are inverses."""
+    from tpu_olap.executor.sharding import chip_of, placement
+    to_place, to_logical = placement(16, 8)
+    per_chip = 2
+    for i in range(16):
+        assert to_logical[to_place[i]] == i
+        assert to_place[i] // per_chip == i % 8 == chip_of(i, 8)
+
+
+def _month_build(num_shards=None, **cfg):
+    rng = np.random.default_rng(11)
+    n = 60_000
+    df = pd.DataFrame({
+        "ts": pd.to_datetime("1993-01-01")
+        + pd.to_timedelta(rng.integers(0, 730, n), unit="D"),
+        "g": rng.choice([f"g{i}" for i in range(16)], n),
+        "v": rng.integers(0, 100, n).astype(np.int64),
+    })
+    eng = Engine(EngineConfig(num_shards=num_shards, **cfg))
+    eng.register_table("m", df, time_column="ts", block_rows=512,
+                       time_partition="month")
+    return eng, df
+
+
+WINDOW_SQL = ("SELECT g, sum(v) AS s FROM m "
+              "WHERE ts >= '1993-03-01' AND ts < '1993-06-01' "
+              "GROUP BY g ORDER BY g")
+
+
+def test_per_chip_window_prunes_working_set():
+    """Interleaved placement turns a contiguous time range into a LOCAL
+    window on every chip: the record carries segments_window_per_chip
+    well under each chip's resident share, and results stay exact."""
+    e1, _ = _month_build()
+    e8, _ = _month_build(num_shards=8)
+    a, b = e1.sql(WINDOW_SQL), e8.sql(WINDOW_SQL)
+    pd.testing.assert_frame_equal(a, b)
+    m = e8.runner.history[-1]
+    n_seg = len(e8.catalog.get("m").segments.segments)
+    per_chip = -(-n_seg // 8)
+    w = m["segments_window_per_chip"]
+    assert w is not None and 0 < w < per_chip, (w, per_chip)
+    assert m["num_shards"] == 8
+    assert m["cost"]["strategy"] in ("historicals", "broker")
+
+
+def test_mesh_tier1_cache_shards_merge_at_broker():
+    """Per-(chip, segment) tier-1 entries under a mesh: the first run
+    populates per-segment partials from the sharded dispatch, the
+    repeat serves them via the host broker fold, and sys.devices
+    reports the per-chip cache-shard census."""
+    e8, _ = _month_build(num_shards=8, segment_cache_enabled=True)
+    a = e8.sql(WINDOW_SQL)
+    m1 = e8.runner.history[-1]
+    assert m1.get("segment_cache") is None  # tier served, not bypassed
+    b = e8.sql(WINDOW_SQL)
+    m2 = e8.runner.history[-1]
+    pd.testing.assert_frame_equal(a, b)
+    assert m2["cache_hit"] and m2["cache_tier"] == "segment"
+    assert m2["segments_cached"] > 0 and m2["segments_computed"] == 0
+    dev = e8.sql("SELECT sum(cache_shard_entries) AS n, count(*) AS d "
+                 "FROM sys.devices")
+    assert int(dev.d[0]) == 8
+    assert int(dev.n[0]) == m2["segments_cached"]
+    # parity against the single-device tier-1 path
+    e1, _ = _month_build(segment_cache_enabled=True)
+    e1.sql(WINDOW_SQL)
+    pd.testing.assert_frame_equal(e1.sql(WINDOW_SQL), b)
+
+
+def test_sys_devices_census():
+    e8, _ = build(num_shards=8)
+    e8.sql(QUERIES[0])
+    out = e8.sql("SELECT * FROM sys.devices")
+    assert len(out) == 8
+    n_seg = len(e8.catalog.get("f").segments.segments)
+    assert int(out.segments.sum()) == n_seg
+    assert (out.chips == 8).all()
+    assert int(out.dispatches.sum()) > 0
+
+
+def test_incremental_replace_on_append():
+    """A delta append re-places ONLY the touched segments' rows: the
+    swapped-in dataset rebases resident stacks device-side instead of
+    re-uploading every column, and mesh results stay exact."""
+    e8, _ = _month_build(num_shards=8)
+    e1, _ = _month_build()
+    base = e8.sql(WINDOW_SQL)
+    row = {"ts": "1994-12-30T00:00:00", "g": "g1", "v": 7}
+    e8.append("m", [row])
+    e1.append("m", [row])
+    got = e8.sql("SELECT count() AS n FROM m")
+    assert int(got.n[0]) == 60_001
+    ds = e8.runner._datasets["m"]
+    assert ds.rebased_cols > 0
+    # uploaded rows bounded by the delta-touched segments, not the table
+    n_seg = len(e8.catalog.get("m").segments.segments)
+    assert ds.rebase_rows_uploaded < n_seg * 512 // 2
+    pd.testing.assert_frame_equal(e1.sql(WINDOW_SQL), e8.sql(WINDOW_SQL))
+    pd.testing.assert_frame_equal(base, e8.sql(WINDOW_SQL))
+
+
+def test_compaction_keeps_untouched_cache_shards():
+    """Partition-aligned incremental compaction shares untouched sealed
+    segments by object, and tier-1 keys ride the segment uid — so only
+    the delta-touched partition's entries invalidate (under a mesh:
+    only the affected chip's cache shard)."""
+    e8, _ = _month_build(num_shards=8, segment_cache_enabled=True,
+                         ingest_auto_compact=False)
+    e8.sql(WINDOW_SQL)          # populate per-segment entries
+    warm = e8.sql(WINDOW_SQL)
+    assert e8.runner.history[-1]["cache_hit"]
+    # append OUTSIDE the queried window, then compact: the queried
+    # months' sealed segments are untouched partitions
+    e8.append("m", [{"ts": "1994-12-30T00:00:00", "g": "g1", "v": 7}])
+    res = e8.compact_now("m")
+    assert res.get("mode") == "incremental", res
+    again = e8.sql(WINDOW_SQL)
+    m = e8.runner.history[-1]
+    pd.testing.assert_frame_equal(warm, again)
+    assert m["cache_hit"], m.get("segment_cache")
+    assert m["segments_cached"] > 0 and m["segments_computed"] == 0, m

@@ -65,6 +65,20 @@ def load_artifact(path: str) -> dict:
                 "p99": {str(c): float(v["p99_ms"])
                         for c, v in doc["per_class"].items()
                         if isinstance(v, dict) and "p99_ms" in v}}
+    if doc.get("mode") == "multichip" and \
+            isinstance(doc.get("per_query"), dict):
+        # sharded-serving artifact (bench.py --mesh N): gate mesh p50
+        # + scaling efficiency + parity per query
+        pq = doc["per_query"]
+        return {"kind": "multichip",
+                "n_devices": int(doc.get("n_devices", 0) or 0),
+                "parity_ok": bool(doc.get("parity_ok")),
+                "p50": {str(q): float(v["p50_mesh_ms"])
+                        for q, v in pq.items()
+                        if isinstance(v, dict) and "p50_mesh_ms" in v},
+                "speedup": {str(q): float(v.get("speedup", 0.0))
+                            for q, v in pq.items()
+                            if isinstance(v, dict)}}
     detail = doc.get("detail") or {}
     per_query = detail.get("per_query_p50_ms")
     if not isinstance(per_query, dict) or not per_query:
@@ -137,6 +151,45 @@ def compare_concurrency(base: dict, new: dict, threshold: float) -> int:
     return 0
 
 
+def compare_multichip(base: dict, new: dict, threshold: float) -> int:
+    """Sharded-serving gate for MULTICHIP_*.json artifacts (bench.py
+    --mesh N): exit 1 when the candidate lost result parity vs the
+    single-device path, any query's MESH p50 regressed past the
+    threshold, or its mesh-vs-1-device speedup collapsed by more than
+    the threshold. Prints the per-query scaling-efficiency column
+    (speedup / n_devices) so ICI-merge or placement regressions are
+    visible even while absolute p50s stay under the gate."""
+    regressions = []
+    if not new["parity_ok"]:
+        regressions.append("parity")
+    nd = max(1, new["n_devices"])
+    rows, _, _ = compare(base["p50"], new["p50"], threshold)
+    w = max([len(q) for q, *_ in rows] or [5])
+    print(f"{'query':<{w}}  {'base ms':>10}  {'new ms':>10}  "
+          f"{'delta':>8}  {'speedup':>8}  {'scale-eff':>9}  gate")
+    for q, b, n, delta, regressed in rows:
+        sp_b = base["speedup"].get(q, 0.0)
+        sp_n = new["speedup"].get(q, 0.0)
+        why = []
+        if regressed:
+            why.append("p50")
+        if sp_b > 0 and (sp_n - sp_b) / sp_b < -threshold:
+            why.append("speedup")
+        if why:
+            regressions.append(f"{q}({','.join(why)})")
+        print(f"{q:<{w}}  {b:>10.3f}  {n:>10.3f}  {delta:>+7.1%}  "
+              f"{sp_n:>7.2f}x  {sp_n / nd:>8.1%}  "
+              f"{'REGRESSED(' + ','.join(why) + ')' if why else 'ok'}")
+    if regressions:
+        print(f"\nbench_compare: multichip regressed past "
+              f"{threshold:.0%}: {', '.join(regressions)}",
+              file=sys.stderr)
+        return 1
+    print(f"\nbench_compare: ok (mesh p50 + scaling within "
+          f"{threshold:.0%}, parity held)")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="Compare per-query SSB p50s of two bench artifacts "
@@ -161,6 +214,8 @@ def main(argv=None) -> int:
               f"{new_art['kind']}")
     if base_art["kind"] == "concurrency":
         return compare_concurrency(base_art, new_art, args.threshold)
+    if base_art["kind"] == "multichip":
+        return compare_multichip(base_art, new_art, args.threshold)
     base, new = base_art["p50"], new_art["p50"]
     rows, only_base, only_new = compare(base, new, args.threshold)
     if not rows:

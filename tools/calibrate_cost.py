@@ -5,7 +5,7 @@ Runs a grid of (rows, group-cardinality) GROUP BY queries through the
 engine on an 8-device mesh, timing BOTH dispatch strategies via the
 force_strategy override:
 
-- "historicals" (shard_map partials + explicit ICI merge), whose model is
+- "historicals" (sharded per-chip partials + host broker merge), whose model is
       t = scan_us + merge_us
         = rows*cols*SCAN/1e3/D  +  hops*(LAT + bytes*MERGE/1e3)
   fitted by least squares over the grid (SCAN from the rows axis at tiny

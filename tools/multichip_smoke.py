@@ -1,0 +1,127 @@
+"""Multi-chip smoke (CI `multichip-smoke` job): on a forced 8-device
+host mesh, run four SSB queries (GroupBy / TopN-shaped / TimeSeries /
+HLL count-distinct — the shapes the retired shard_map path used to
+fail on) through the `jit` + `NamedSharding` sharded dispatch and
+assert (1) sha256-identical result frames vs the single-device path,
+(2) the records really rode the mesh (num_shards == 8, a merge
+strategy stamped), (3) a time-filtered query pruned its PER-CHIP
+working set (interleaved placement: the local window is a fraction of
+each chip's resident segments), and (4) the sparse fan-out broker
+merge answers with parity. Exits non-zero on any violation.
+Seconds-scale — a pre-merge gate, not a bench (docs/TPU_NOTES.md)."""
+
+import hashlib
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SMOKE_QUERIES = {
+    "groupby": """
+        SELECT p_brand1, sum(lo_revenue) AS rev, count(*) AS n
+        FROM lineorder JOIN part ON lo_partkey = p_partkey
+        WHERE p_category = 'MFGR#12' GROUP BY p_brand1
+        ORDER BY p_brand1
+    """,
+    "timeseries": """
+        SELECT year(__time) AS yr, sum(lo_revenue) AS rev
+        FROM lineorder GROUP BY year(__time) ORDER BY yr
+    """,
+    "windowed": """
+        SELECT s_region, sum(lo_revenue) AS rev
+        FROM lineorder JOIN supplier ON lo_suppkey = s_suppkey
+        WHERE __time >= '1993-03-01' AND __time < '1993-09-01'
+        GROUP BY s_region ORDER BY s_region
+    """,
+    "hll": """
+        SELECT s_region, approx_count_distinct(lo_custkey) AS u
+        FROM lineorder JOIN supplier ON lo_suppkey = s_suppkey
+        GROUP BY s_region ORDER BY s_region
+    """,
+}
+
+
+def _digest(frame) -> str:
+    return hashlib.sha256(
+        frame.to_csv(float_format="%.6g").encode()).hexdigest()
+
+
+def main() -> int:
+    from tpu_olap.utils.platform import force_cpu_devices
+    force_cpu_devices(8)
+
+    from tpu_olap import Engine
+    from tpu_olap.bench.ssb import generate_tables, register_ssb
+    from tpu_olap.executor import EngineConfig
+
+    tables = generate_tables(120_000, seed=5)
+    e1 = Engine(EngineConfig())
+    e8 = Engine(EngineConfig(num_shards=8))
+    for e in (e1, e8):
+        register_ssb(e, tables, block_rows=1 << 11)
+
+    failures = []
+    for name, sql in SMOKE_QUERIES.items():
+        a = e1.sql(sql)
+        b = e8.sql(sql)
+        if not e8.last_plan.rewritten:
+            failures.append(
+                f"{name}: mesh plan fell back: "
+                f"{e8.last_plan.fallback_reason}")
+            continue
+        da, db = _digest(a), _digest(b)
+        rec = dict(e8.runner.history[-1])
+        print(f"[multichip-smoke] {name}: sha256 "
+              f"{'OK' if da == db else 'MISMATCH'} "
+              f"num_shards={rec.get('num_shards')} "
+              f"merge={rec.get('merge')} "
+              f"win/chip={rec.get('segments_window_per_chip')}")
+        if da != db:
+            failures.append(f"{name}: digest mismatch {da} vs {db}")
+        if rec.get("num_shards") != 8:
+            failures.append(f"{name}: num_shards={rec.get('num_shards')}")
+        if name == "windowed":
+            # per-chip pruning: the interleaved placement must have cut
+            # each chip's working set to a LOCAL window well under its
+            # resident share
+            per_chip_total = -(-len(
+                e8.catalog.get("lineorder").segments.segments) // 8)
+            w = rec.get("segments_window_per_chip")
+            if not w or w >= per_chip_total:
+                failures.append(
+                    f"windowed: no per-chip window (w={w}, "
+                    f"per_chip={per_chip_total})")
+
+    # sparse fan-out + broker merge (high-cardinality GROUP BY)
+    sparse_sql = ("SELECT lo_custkey, sum(lo_revenue) AS rev, "
+                  "count(*) AS n FROM lineorder GROUP BY lo_custkey "
+                  "ORDER BY lo_custkey LIMIT 20")
+    es1 = Engine(EngineConfig(dense_group_budget=64))
+    es8 = Engine(EngineConfig(dense_group_budget=64, num_shards=8))
+    for e in (es1, es8):
+        register_ssb(e, tables, block_rows=1 << 11)
+    sa, sb = es1.sql(sparse_sql), es8.sql(sparse_sql)
+    rec = dict(es8.runner.history[-1])
+    ok = _digest(sa) == _digest(sb) and rec.get("sparse") \
+        and rec.get("num_shards") == 8
+    print(f"[multichip-smoke] sparse-fanout: "
+          f"{'OK' if ok else 'FAIL'} groups={rec.get('result_groups')}")
+    if not ok:
+        failures.append(f"sparse-fanout: rec={rec}")
+
+    # sys.devices census reflects the 8-chip placement
+    devs = e8.sql("SELECT count(*) AS n FROM sys.devices")
+    if int(devs.n[0]) != 8:
+        failures.append(f"sys.devices rows={int(devs.n[0])} != 8")
+
+    if failures:
+        for f in failures:
+            print("[multichip-smoke] FAIL:", f, file=sys.stderr)
+        return 1
+    print("[multichip-smoke] all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

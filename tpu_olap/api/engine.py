@@ -100,8 +100,9 @@ class Engine:
         # sys.* virtual datasources (catalog.systables; ISSUE 11): the
         # engine is observable through its own SQL — sys.tables /
         # sys.segments / sys.queries / sys.query_templates / sys.metrics
-        # / sys.caches resolve through the catalog to live-state frames
-        # served on the interpreter path with accounting suppressed
+        # / sys.caches / sys.cubes / sys.checkpoints / sys.devices
+        # resolve through the catalog to live-state frames served on
+        # the interpreter path with accounting suppressed
         self.catalog.sys_provider = SysTableProvider(self)
         # materialized rollup cubes (tpu_olap.cubes; docs/CUBES.md):
         # registry of (dim subset x grain) partial-aggregate rollups;

@@ -46,6 +46,10 @@ equivalent is a JSON-over-HTTP surface (stdlib only, no new deps):
                      per cube dims/grain/rows, base-vs-cube generation,
                      last refresh, build cost, and rewrite serve counts
                      — the SQL spelling is SELECT * FROM sys.cubes
+  GET  /debug/devices  per-chip serving state (executor/sharding.py):
+                     interleaved segment placement, resident bytes,
+                     dispatch participation, tier-1 cache-shard entries
+                     — the SQL spelling is SELECT * FROM sys.devices
   GET  /debug/workload  the query-template profiler (obs.workload):
                      top templates with latency percentiles and cache
                      hit-rates, plus ranked rollup-cube recommendations
@@ -387,6 +391,14 @@ class QueryServer:
             return {"enabled": bool(eng.config.cube_rewrite_enabled),
                     "auto_refresh": bool(eng.config.cube_auto_refresh),
                     "cubes": eng.cubes.snapshot()}
+        if path == "/debug/devices" or path.startswith("/debug/devices?"):
+            # per-chip serving state (executor/sharding.py): interleaved
+            # segment placement, resident bytes, dispatch participation,
+            # tier-1 cache-shard entries, incremental re-place stats —
+            # the SQL spelling is SELECT * FROM sys.devices
+            eng = self.engine
+            return {"num_shards": int(eng.config.num_shards or 1),
+                    "devices": eng.runner.device_snapshot()}
         if path == "/debug/ingest" or path.startswith("/debug/ingest?"):
             # real-time ingest state (segments/delta.py;
             # docs/INGEST.md): per-table delta rows/segments, sealed

@@ -27,6 +27,10 @@ Datasources (column tables in docs/OBSERVABILITY.md):
   sys.checkpoints      durable sealed-segment checkpoints per table:
                        manifest id, WAL watermark vs acked seq, spilled
                        bytes, chunk reuse (docs/DURABILITY.md)
+  sys.devices          per-chip serving state under the interleaved
+                       segment placement (executor/sharding.py):
+                       segments owned, resident bytes, dispatch
+                       participation, tier-1 cache-shard entries
 """
 
 from __future__ import annotations
@@ -218,6 +222,24 @@ def _checkpoints_frame(engine) -> pd.DataFrame:
                         columns=list(_CHECKPOINT_COLS))
 
 
+_DEVICE_COLS = (
+    "index", "device", "platform", "process", "chips", "segments",
+    "resident_bytes", "dispatches", "cache_shard_entries",
+    "rebased_cols", "rebase_rows_uploaded")
+
+
+def _devices_frame(engine) -> pd.DataFrame:
+    """sys.devices: one row per mesh chip (or the single device) — the
+    interleaved-placement census (logical segments owned = those with
+    id ≡ chip mod D), per-chip resident bytes, multi-chip dispatch
+    participation, and tier-1 cache-SHARD entry counts (an entry's chip
+    is its segment's placement owner). `rebased_*` columns surface the
+    incremental re-place path (only delta-touched segments' rows
+    re-upload on an ingest snapshot swap)."""
+    return pd.DataFrame(engine.runner.device_snapshot(),
+                        columns=list(_DEVICE_COLS))
+
+
 def _caches_frame(engine) -> pd.DataFrame:
     runner = engine.runner
     snap = runner.result_cache.snapshot()
@@ -258,6 +280,7 @@ class SysTableProvider:
         "sys.caches": _caches_frame,
         "sys.cubes": _cubes_frame,
         "sys.checkpoints": _checkpoints_frame,
+        "sys.devices": _devices_frame,
     }
 
     def __init__(self, engine):

@@ -3,7 +3,9 @@
 table's segments to a jitted XLA program, caches compiled programs by query
 *template* (literals stripped), keeps columns HBM-resident, and assembles
 Druid-shaped results host-side. Multi-chip execution shards the segment axis
-over a Mesh and merges partials with XLA collectives (sharding.py).
+over a `NamedSharding` mesh with interleaved placement and merges per-chip
+unfinalized partials at a host broker — or hands the whole program to
+XLA's GSPMD partitioner (sharding.py; planner/cost.py picks).
 """
 
 from tpu_olap.executor.config import EngineConfig  # noqa: F401

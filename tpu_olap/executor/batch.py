@@ -55,7 +55,11 @@ AGG_QUERY_TYPES = (TimeseriesQuerySpec, GroupByQuerySpec, TopNQuerySpec)
 
 def fusable(plan, mesh) -> str | None:
     """None when the plan can ride a fused shared-scan dispatch, else the
-    reason it must run alone (through the single-query path)."""
+    reason it must run alone (through the single-query path). Mesh legs
+    fuse too: each leg's group key extends by the owning chip inside
+    the ONE fused program, per-leg [D·K] partials come back sharded,
+    and the host broker merges each leg (executor.sharding) — the
+    shared scan happens within each chip's resident shard."""
     if plan.kind != "agg":
         return "only aggregation plans fuse"
     if plan.sparse:
@@ -63,7 +67,11 @@ def fusable(plan, mesh) -> str | None:
     if plan.key_fn is None:
         return "plan has no batchable key_fn"
     if mesh is not None:
-        return "mesh sharding not supported on the batch path"
+        if mesh.devices.size * plan.total_groups >= (1 << 31):
+            return "chip-extended group key overflows int32"
+        from tpu_olap.executor.sharding import is_multihost
+        if is_multihost(mesh):
+            return "multi-host mesh legs run alone"
     return None
 
 
@@ -284,10 +292,18 @@ def _run_fused(runner, table, group, query_ids=None):
                     env, valid, seg_mask = runner._prepare(plan, m)
                     leg_envs.append(env)
                     seg_masks.append(seg_mask)
-                win = _union_window(plans, len(seg_masks[0]))
+                win = _union_window(plans, len(seg_masks[0]),
+                                    runner.mesh)
                 if win is not None:
+                    # same units as the single-query mesh path:
+                    # segments_window is the GLOBAL window (W x D under
+                    # a mesh), per_chip the local width
+                    D_win = runner.mesh.devices.size \
+                        if runner.mesh is not None else 1
                     for m in metrics_list:
-                        m["segments_window"] = win[1]
+                        m["segments_window"] = win[1] * D_win
+                        if runner.mesh is not None:
+                            m["segments_window_per_chip"] = win[1]
                 enq = pin = None
                 if runner.config.platform != "cpu":
                     enq = _enqueue_fused_device(
@@ -304,6 +320,14 @@ def _run_fused(runner, table, group, query_ids=None):
                                         seg_masks, win) + (False,)
             outs_dev, hit, t_fire = enq
             outs = runner._fetch_tree(outs_dev, metrics_list[0], pin)
+            if runner.mesh is not None:
+                # broker step: each leg's per-chip [D·K] unfinalized
+                # partials fold on the host with the segment-cache
+                # merge algebra (executor.sharding.broker_merge)
+                from tpu_olap.executor.sharding import broker_merge
+                D = runner.mesh.devices.size
+                outs = [broker_merge(o, p.agg_plans, D)
+                        for o, p in zip(outs, plans)]
             shared_ms = (time.perf_counter() - t_fire) * 1000
             # per-leg attribution: one XLA program cannot be timed from
             # outside per leg; split the shared wall by each leg's
@@ -350,7 +374,8 @@ def _run_fused(runner, table, group, query_ids=None):
             m["scan_ms_shared"] = shared_ms
             m["agg_ms"] = leg_ms
             m["jit_cache_hit"] = hit
-            m["num_shards"] = 1
+            m["num_shards"] = runner.mesh.devices.size \
+                if runner.mesh is not None else 1
             m["assemble_ms"] = (time.perf_counter() - t0) * 1000
             m["total_ms"] = (time.perf_counter() - t_start) * 1000
             res.metrics = m
@@ -364,15 +389,21 @@ def _run_fused(runner, table, group, query_ids=None):
     return results
 
 
-def _union_window(plans, n_segments):
+def _union_window(plans, n_segments, mesh=None):
     """(lo, W) covering every leg's pruned segments, or None — the batch
     analog of QueryRunner._segment_window. Legs whose own pruned set is
     smaller still read only the union window; their per-leg seg_mask
     zeroes the rest (adding exact zeros, so per-query results stay
-    bitwise identical to the single-query windowed pass)."""
+    bitwise identical to the single-query windowed pass). Under a mesh
+    the window is the per-chip LOCAL one (interleaved placement:
+    logical [lo, hi) is local [lo//D, ceil(hi/D)) on every chip)."""
     ids = sorted({i for p in plans if not p.empty for i in p.pruned_ids})
     if not ids:
         return None
+    if mesh is not None:
+        from tpu_olap.executor.sharding import local_window
+        D = mesh.devices.size
+        return local_window(ids, D, n_segments // D)
     lo, hi = ids[0], ids[-1] + 1
     W = _next_pow2(hi - lo)
     if 4 * W >= 3 * n_segments:
@@ -407,10 +438,14 @@ def _layout_key(layouts):
                   tuple(sorted(s["nulls"].items()))) for s in layouts)
 
 
-def _build_fused(plans, layouts):
+def _build_fused(plans, layouts, mesh_dims=None):
     """The fused kernel: every leg's (filter, dims, key) front half runs
     over the shared buffers, then kernels.groupby.group_reduce_batch
-    emits N independent partials dicts — all traced into one program."""
+    emits N independent partials dicts — all traced into one program.
+    mesh_dims=(D, blocks): each leg's key extends by the owning chip
+    (row block b belongs to chip b // blocks in placement order), so
+    per-leg [D·K] partials come back sharded and the host broker
+    merges them (executor.sharding.broker_merge)."""
     def fused(buffers, valid, seg_masks, consts_list):
         legs = []
         for plan, spec, sm, consts in zip(plans, layouts, seg_masks,
@@ -420,16 +455,35 @@ def _build_fused(plans, layouts):
                    "nulls": {n: buffers[j]
                              for n, j in spec["nulls"].items()}}
             fenv, mask, key = plan.key_fn(env, valid, sm, consts)
-            legs.append((key, mask, fenv, plan.agg_plans,
-                         plan.total_groups))
+            num_groups = plan.total_groups
+            if mesh_dims is not None:
+                from tpu_olap.executor.sharding import chip_extended_key
+                D, blocks = mesh_dims
+                key = chip_extended_key(key, mask, D, blocks,
+                                        num_groups)
+                num_groups = D * num_groups
+            legs.append((key, mask, fenv, plan.agg_plans, num_groups))
         return group_reduce_batch(legs, consts_list)
     return fused
 
 
-def _window_fused(fused, W: int):
+def _window_fused(fused, W: int, mesh=None, per_chip: int = 0):
     """Dynamic-slice every [S, ...] input to the union window before the
-    fused compute (one compile per (composition, W); `lo` is traced)."""
+    fused compute (one compile per (composition, W); `lo` is traced).
+    Under a mesh the slice is per-chip LOCAL (reshape to (chip, local),
+    slice the unsharded local axis — no cross-chip movement)."""
     import jax
+
+    if mesh is not None:
+        from tpu_olap.executor.sharding import _slice_local
+        D = mesh.devices.size
+
+        def fn(buffers, valid, seg_masks, consts_list, lo):
+            def sl(a):
+                return _slice_local(a, D, per_chip, lo, W)
+            return fused([sl(b) for b in buffers], sl(valid),
+                         [sl(m) for m in seg_masks], consts_list)
+        return fn
 
     def fn(buffers, valid, seg_masks, consts_list, lo):
         def sl(a):
@@ -448,33 +502,45 @@ def _enqueue_fused_device(runner, table, plans, leg_envs, valid,
     import jax
 
     buffers, layouts = _buffer_layout(leg_envs)
+    mesh = runner.mesh
+    D = mesh.devices.size if mesh is not None else 0
+    per_chip = len(seg_masks[0]) // D if mesh is not None else 0
     # the layout is part of the key: a cached program's closure bakes in
     # its compile-time {name: buffer-index} maps, and the SHARING
     # structure can legitimately change between dispatches (an HBM-ledger
     # eviction between two legs' _prepare calls refetches a column as a
     # distinct object) — reusing the old closure over a differently-
     # shaped buffer list would read the wrong column
-    key = (table.name, "batch",
+    key = (table.name, "batch", D,
            tuple(p.fingerprint() for p in plans),
            win[1] if win else 0,
            _layout_key(layouts))
     jitted = runner._jit_cache.get(key)
     hit = jitted is not None
     if not hit:
-        fused = _build_fused(plans, layouts)
+        mesh_dims = None
+        if mesh is not None:
+            mesh_dims = (D, win[1] if win is not None else per_chip)
+        fused = _build_fused(plans, layouts, mesh_dims)
         if win is not None:
-            fused = _window_fused(fused, win[1])
-        jitted = jax.jit(fused)
+            fused = _window_fused(fused, win[1], mesh, per_chip)
+        if mesh is not None:
+            from tpu_olap.executor.sharding import shard_spec
+            jitted = jax.jit(fused, out_shardings=shard_spec(mesh))
+        else:
+            jitted = jax.jit(fused)
         runner._jit_cache[key] = jitted
     consts_list, seg_args = [], []
     for plan, sm in zip(plans, seg_masks):
-        cdev, sarg = runner._args_for(plan, sm, None)
+        cdev, sarg = runner._args_for(plan, sm, mesh)
         consts_list.append(cdev)
         seg_args.append(sarg)
     t0 = time.perf_counter()
     outs = jitted(buffers, valid, seg_args, consts_list, win[0]) \
         if win is not None else jitted(buffers, valid, seg_args,
                                        consts_list)
+    if mesh is not None:
+        runner._note_chip_dispatch(range(D))
     return outs, hit, t0
 
 

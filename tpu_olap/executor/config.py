@@ -52,10 +52,15 @@ class EngineConfig:
     # legitimately-sized dense queries (e.g. hourly-year theta
     # timeseries) on the dense path
     dense_sketch_state_budget: int = 1 << 28
-    # multi-chip sparse merge strategy: "exchange" = hash-partitioned
-    # all_to_all (present groups scale with chip count: capacity is
-    # D x sparse_group_budget when keys distribute), "gather" = legacy
-    # all-gather-everything (every chip re-merges all D tables).
+    # multi-chip sparse merge strategy: both run per-chip local
+    # compaction as fan-out single-device programs over the resident
+    # shards, then the host BROKER re-merges the D compact tables
+    # (executor/sharding.py). "exchange" lets the broker table hold
+    # D x sparse_group_budget present groups (capacity scales with chip
+    # count, any key skew absorbed — there are no hash owners);
+    # "gather" keeps the legacy global-budget contract (all groups must
+    # fit one chip's table). A multi-host (DCN) mesh hands the whole
+    # sparse program to GSPMD instead (global-budget capacity).
     sparse_merge: str = "exchange"
 
     # segments per device dispatch (flattened rows = batch × block_rows)
@@ -236,9 +241,12 @@ class EngineConfig:
     # execution platform: "device" = default jax backend, "cpu" = numpy path
     platform: str = "device"
 
-    # multi-chip: shard the segment axis across this many devices on a 1-D
-    # mesh (None/1 = single device). The analog of the reference's
-    # queryHistoricalServers fan-out (SURVEY.md §3.5 P2).
+    # multi-chip: shard the segment axis across this many devices on a
+    # 1-D 'chips' mesh (None/1 = single device) — jit + NamedSharding
+    # over an INTERLEAVED segment->chip placement (executor/sharding.py:
+    # segment i -> chip i mod D, so any time range load-balances and
+    # windowed dispatch prunes per-chip working sets). The analog of the
+    # reference's queryHistoricalServers fan-out (SURVEY.md §3.5 P2).
     num_shards: int | None = None
 
     # emit empty time buckets in timeseries results (Druid default)

@@ -98,13 +98,24 @@ class HbmLedger:
 class DeviceDataset:
     """Lazy per-column stacks for one table on one platform.
 
-    With a mesh, stacks are padded to a multiple of the shard count with
-    fully-invalid segments and device_put sharded on the segment axis —
-    every chip holds 1/D of each column in its HBM.
+    With a mesh, stacks are padded to a multiple of the chip count with
+    fully-invalid segments, reordered into the INTERLEAVED placement
+    (executor.sharding.placement: logical segment i → chip i mod D, so
+    chip c's contiguous NamedSharding block holds its interleaved
+    segments) and device_put sharded on the segment axis — every chip
+    holds 1/D of each column in its HBM, and any contiguous time range
+    of logical segments is load-balanced across all chips.
+
+    Snapshot swaps (real-time appends, incremental compaction) pass the
+    superseded dataset as `prev`: resident columns REBASE on device —
+    rows of segments shared by identity with the old snapshot are
+    gathered from the old device stack, and only delta-touched
+    segments' rows upload (the ROADMAP 4c "appendable device buffers"
+    fix: a small append no longer re-uploads every column).
     """
 
     def __init__(self, table: TableSegments, platform: str = "device",
-                 mesh=None, ledger: HbmLedger | None = None):
+                 mesh=None, ledger: HbmLedger | None = None, prev=None):
         self.table = table
         self.platform = platform
         self.mesh = mesh
@@ -114,10 +125,44 @@ class DeviceDataset:
         self._derived: dict[str, object] = {}
         self._valid = None
         n_seg = len(table.segments)
+        self.to_place = self.to_logical = None
+        self.n_chips = 1
         if mesh is not None:
-            from tpu_olap.executor.sharding import pad_segments
-            n_seg = pad_segments(max(n_seg, 1), mesh.devices.size)
+            from tpu_olap.executor.sharding import (pad_segments,
+                                                    placement)
+            self.n_chips = mesh.devices.size
+            n_seg = pad_segments(max(n_seg, 1), self.n_chips)
+            self.to_place, self.to_logical = placement(n_seg,
+                                                       self.n_chips)
         self.shape = (n_seg, table.block_rows)
+        # incremental re-place (docs/INGEST.md): snapshot the old
+        # dataset's resident stacks + placement so each column can
+        # rebase device-side, uploading only changed segments' rows
+        self._rebase = None
+        self.rebased_cols = 0
+        self.rebase_rows_uploaded = 0
+        if (prev is not None and platform != "cpu"
+                and prev.platform == platform
+                and prev.table is not table
+                and prev.table.block_rows == table.block_rows
+                and prev.mesh is mesh):
+            old_segs = prev.table.segments
+            # uid equality, not object identity: incremental compaction
+            # re-wraps untouched partitions in fresh Segment shells
+            # around the SAME column arrays, carrying the uid over
+            changed = [i for i, s in enumerate(table.segments)
+                       if i >= len(old_segs)
+                       or s.uid != old_segs[i].uid]
+            # only worth the gather/scatter when most rows carry over
+            if changed and len(changed) * 2 <= len(table.segments):
+                self._rebase = {
+                    "cols": dict(prev._cols),
+                    "nulls": dict(prev._nulls),
+                    "valid": prev._valid,
+                    "old_place": prev.to_place,
+                    "old_n": prev.shape[0],
+                    "changed": changed,
+                }
 
     def _put(self, arr: np.ndarray):
         if self.platform == "cpu":
@@ -128,6 +173,60 @@ class DeviceDataset:
             return shard_put(arr, self.mesh)
         return jax.device_put(arr)
 
+    def _place_pos(self, logical_ids, old: bool = False) -> np.ndarray:
+        """Placed positions of logical segment ids (identity without a
+        mesh; the interleave permutation with one)."""
+        ids = np.asarray(logical_ids, np.int64)
+        perm = self._rebase["old_place"] if old else self.to_place
+        if perm is None:
+            return ids
+        return np.asarray(perm, np.int64)[ids]
+
+    def _rebase_stack(self, old_arr, per_segment, target_dtype):
+        """New device stack from the old snapshot's resident stack:
+        unchanged segments gather from device memory, changed segments'
+        rows upload. None when ineligible (dtype drift, no old stack) —
+        the caller falls back to a full _stack + _put."""
+        rb = self._rebase
+        if rb is None or old_arr is None:
+            return None
+        if target_dtype is not None and \
+                np.dtype(old_arr.dtype) != np.dtype(target_dtype):
+            return None  # narrowed dtype widened: full re-upload
+        import jax
+        import jax.numpy as jnp
+        changed = rb["changed"]
+        n_new = len(self.table.segments)
+        changed_set = set(changed)
+        keep = [i for i in range(n_new)
+                if i not in changed_set and i < rb["old_n"]]
+        fresh = np.stack([per_segment(self.table.segments[i])
+                          for i in changed])
+        old_pos = self._place_pos(keep, old=True)
+        new_pos_keep = self._place_pos(keep)
+        new_pos_changed = self._place_pos(changed)
+        S_new = self.shape[0]
+
+        def build(old, up):
+            base = jnp.zeros((S_new,) + old.shape[1:], old.dtype)
+            if keep:
+                base = base.at[new_pos_keep].set(old[old_pos])
+            # explicit cast: jax promotes scatter values strictly, and a
+            # weakly-typed uploaded block must not widen an int8 stack
+            return base.at[new_pos_changed].set(up.astype(old.dtype))
+
+        if self.mesh is not None:
+            from tpu_olap.executor.sharding import shard_spec
+            out = jax.jit(build,
+                          out_shardings=shard_spec(self.mesh))(old_arr,
+                                                               fresh)
+        else:
+            out = jax.jit(build)(old_arr, fresh)
+        self.rebased_cols += 1
+        self.rebase_rows_uploaded += int(fresh.size // max(
+            1, self.table.block_rows)) * self.table.block_rows
+        return out
+
     def _stack(self, per_segment, dtype=None) -> np.ndarray:
         rows = [per_segment(s) for s in self.table.segments]
         fill = self.shape[0] - len(rows)
@@ -135,7 +234,11 @@ class DeviceDataset:
             proto = rows[0] if rows else np.zeros(self.table.block_rows,
                                                   dtype or np.int32)
             rows = rows + [np.zeros_like(proto)] * fill
-        return np.stack(rows)
+        out = np.stack(rows)
+        if self.to_logical is not None:
+            # placement (chip-major) order: placed[p] = logical[tl[p]]
+            out = out[self.to_logical]
+        return out
 
     def _narrow_dtype(self, name: str):
         """Smallest int dtype (int8/int16/int32/int64) holding every
@@ -174,7 +277,12 @@ class DeviceDataset:
             dt = self._narrow_dtype(name)
             get = (lambda s: s.columns[name]) if dt is None else \
                 (lambda s: s.columns[name].astype(dt, copy=False))
-            self._cols[name] = self._put(self._stack(get))
+            arr = None
+            if self._rebase is not None:
+                arr = self._rebase_stack(
+                    self._rebase["cols"].pop(name, None), get, dt)
+            self._cols[name] = arr if arr is not None \
+                else self._put(self._stack(get))
             self._ledger_add("col", name, self._cols[name], pinned)
         elif self.ledger is not None:
             self.ledger.touch((self.table.name, "col", name))
@@ -185,8 +293,13 @@ class DeviceDataset:
         if name not in self._nulls:
             if any(name in s.null_masks for s in self.table.segments):
                 zero = np.zeros(self.table.block_rows, bool)
-                self._nulls[name] = self._put(
-                    self._stack(lambda s: s.null_masks.get(name, zero)))
+                get = lambda s: s.null_masks.get(name, zero)  # noqa: E731
+                arr = None
+                if self._rebase is not None:
+                    arr = self._rebase_stack(
+                        self._rebase["nulls"].pop(name, None), get, bool)
+                self._nulls[name] = arr if arr is not None \
+                    else self._put(self._stack(get))
                 self._ledger_add("null", name, self._nulls[name], pinned)
             else:
                 self._nulls[name] = None
@@ -218,17 +331,37 @@ class DeviceDataset:
 
     def valid(self):
         """[S, R] row-validity (padding rows/segments are False).
-        Never ledgered: every query needs it and it is 1 byte/row."""
+        Never ledgered: every query needs it and it is 1 byte/row.
+
+        valid() is the LAST rebase consumer of a dispatch's working-set
+        build (env() columns first, then validity — see
+        QueryRunner._prepare_inner), so the rebase snapshot drops here:
+        holding it longer would keep the superseded dataset's entire
+        device-resident column set alive UNACCOUNTED (prev.evict()
+        already released its ledger entries). Columns first touched by
+        a later query pay a full upload instead — the hot columns (the
+        ones being queried during ingest) are exactly the first
+        dispatch's set."""
         if self._valid is None:
             r = np.arange(self.table.block_rows)
-            self._valid = self._put(
-                self._stack(lambda s: r < s.meta.n_valid, bool))
+            get = lambda s: r < s.meta.n_valid  # noqa: E731
+            arr = None
+            if self._rebase is not None:
+                arr = self._rebase_stack(self._rebase["valid"], get,
+                                         bool)
+            self._valid = arr if arr is not None \
+                else self._put(self._stack(get, bool))
+        self._rebase = None
         return self._valid
 
     def segment_mask(self, kept_ids) -> np.ndarray:
-        """Host-side [S] bool from pruned segment ids (device input arg)."""
+        """Host-side [S] bool from pruned LOGICAL segment ids (device
+        input arg). Under a mesh the mask comes back in PLACEMENT order
+        to match the placed column stacks."""
         m = np.zeros(self.shape[0], bool)
         m[list(kept_ids)] = True
+        if self.to_logical is not None:
+            m = m[self.to_logical]
         return m
 
     def env(self, columns, null_cols):
@@ -264,5 +397,6 @@ class DeviceDataset:
         self._nulls.clear()
         self._derived.clear()
         self._valid = None
+        self._rebase = None
         if self.ledger is not None:
             self.ledger.remove_table(self.table.name)

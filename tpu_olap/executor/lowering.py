@@ -423,14 +423,15 @@ def _lower_agg(query, table, config) -> PhysicalPlan:
     # sketch aggregates keep [groups × radix] state PER AGGREGATION: at
     # large K their TOTAL dominates memory long before the group COUNT
     # exceeds the dense budget (observed: a 1M-group theta query
-    # allocating >100 GB). Theta's mesh merge additionally all_gathers
-    # [D, K, k] per device (executor/sharding.py::merge_collective), so
-    # its state multiplies by the mesh size — a fuzz-found sharded
-    # theta query ground a host to 100 GB and an XLA rendezvous abort
-    # with per-sketch state that looked safe unscaled. Budget the
-    # summed, mesh-scaled element count — over budget, the sparse path
-    # (clamped sketch width, all_to_all exchange) serves it when it
-    # can; shapes with no sparse path decline legibly, never allocate
+    # allocating >100 GB). The mesh's chip-extended partials ([D·K, k]
+    # theta tables, executor/sharding.py::mesh_agg_kernel) multiply
+    # that state by the mesh size — a fuzz-found sharded theta query
+    # ground a host to 100 GB and an XLA rendezvous abort with
+    # per-sketch state that looked safe unscaled. Budget the summed,
+    # mesh-scaled element count — over budget, the sparse path
+    # (clamped sketch width, per-chip fan-out + broker merge) serves it
+    # when it can; shapes with no sparse path decline legibly, never
+    # allocate
     theta_radix = sum(p.theta_k for p in agg_plans if p.kind == "theta")
     other_radix = sum(_radix(p) for p in agg_plans
                       if p.kind != "theta" and _radix(p) > 1)

@@ -23,8 +23,9 @@ Tier 1 — per-segment partial aggregates (`SegmentCache`). Keyed by
   timeseries sliding its window re-uses yesterday's per-segment rows.
   Non-mergeable shapes bypass the tier (sparse group-by — its compact
   tables are capacity-dependent; scan/select/search — row sets, not
-  partials; interval-dependent timeformat dimensions; mesh-sharded
-  dispatch).
+  partials; interval-dependent timeformat dimensions). Mesh-sharded
+  dispatch IS served: per-(chip, segment) partials come back sharded
+  per chip and fold at the host broker with the same merge algebra.
 
 Tier 2 — full results (`FullResultCache`). Keyed by (normalized query
   JSON including intervals, table generation).  A bounded LRU over the
@@ -279,18 +280,26 @@ class ResultCache:
     def tier1_bypass_reason(self, plan, mesh) -> str | None:
         """None when the per-segment tier can serve this plan, else why
         not — surfaced in the record (`segment_cache`) and the
-        EXPLAIN ANALYZE span so the decision is operator-visible."""
+        EXPLAIN ANALYZE span so the decision is operator-visible.
+        Mesh-sharded dispatch is served too: the per-(chip, segment)
+        partials come back sharded per chip and merge at the broker
+        (QueryRunner._run_seg_partials mesh variant) — budgets below
+        use the chip-padded segment count that program covers."""
         if plan.kind != "agg":
             return "not an aggregation plan"
         if plan.sparse or plan.key_fn is None:
             return "sparse group-by partials are capacity-dependent"
-        if mesh is not None:
-            return "mesh-sharded dispatch"
         if plan.empty or not plan.pruned_ids:
             return "no scanned segments"
         if any(dp.kind == "timeformat" for dp in plan.dim_plans):
             return "timeformat dimension layout is interval-dependent"
         n_seg = len(plan.table.segments)
+        if mesh is not None:
+            from tpu_olap.executor.sharding import (is_multihost,
+                                                    pad_segments)
+            if is_multihost(mesh):
+                return "multi-host mesh (remote shards not addressable)"
+            n_seg = pad_segments(max(n_seg, 1), mesh.devices.size)
         from tpu_olap.kernels.groupby import partials_radix
         radix = partials_radix(plan.agg_plans)
         state = n_seg * plan.total_groups * radix
@@ -320,7 +329,7 @@ class ResultCache:
         sealed set itself changes (registration, compaction)."""
         out = {}
         for sid in seg_ids:
-            key = (tkey, table.segment_generation(sid), sid)
+            key = (tkey, table.segment_cache_token(sid), sid)
             with self._lock:
                 e = self._seg.get(key)
                 if e is not None:
@@ -340,7 +349,7 @@ class ResultCache:
 
     def put_segment(self, tkey, table, plan, sid, partials):
         entry = _SegmentEntry(partials, plan, table.name)
-        key = (tkey, table.segment_generation(sid), sid)
+        key = (tkey, table.segment_cache_token(sid), sid)
         with self._lock:
             old = self._seg.pop(key, None)
             if old is not None:
@@ -411,6 +420,18 @@ class ResultCache:
         with self._lock:
             return {(k[0][0], k[2]) for k in self._seg}
 
+    def shard_entries(self, num_shards: int) -> dict:
+        """{chip index: live tier-1 entries} under the interleaved
+        placement (chip of segment sid = sid mod D) — the cache-shard
+        census behind sys.devices / GET /debug/devices."""
+        d = max(1, int(num_shards))
+        out: dict = {}
+        with self._lock:
+            for k in self._seg:
+                c = int(k[2]) % d
+                out[c] = out.get(c, 0) + 1
+        return out
+
     def count_bypass(self, tier: str = "segment"):
         self._count(tier, "bypass")
 
@@ -452,6 +473,30 @@ class ResultCache:
         if self.events is not None and (purged["full"]
                                         or purged["segment"]):
             self.events.emit("cache_invalidate", table=table, **purged)
+        return purged
+
+    def invalidate_compacted(self, table: str, live_tokens: set):
+        """Compaction swap: tier-2 purges fully (the overall generation
+        moved, every full result is stale), but tier-1 keeps entries
+        whose segment token is still LIVE — untouched partitions carry
+        their Segment uid through the incremental rebuild
+        (segments/delta.py), so only the delta-touched partitions'
+        entries drop (under a mesh: only the affected chips' cache
+        shards)."""
+        purged = self.clear(table, tiers=("full",))
+        with self._lock:
+            dead = [k for k in list(self._seg)
+                    if k[0][0] == table and k[1] not in live_tokens]
+            for k in dead:
+                v = self._seg.pop(k, None)
+                if v is not None:
+                    self._seg_bytes -= v.nbytes
+            purged["segment"] = len(dead)
+            self._refresh_gauges()
+        if self.events is not None and (purged["full"]
+                                        or purged["segment"]):
+            self.events.emit("cache_invalidate", table=table,
+                             scope="compacted", **purged)
         return purged
 
     def invalidate_full(self, table: str):

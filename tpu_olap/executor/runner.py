@@ -197,6 +197,7 @@ class QueryRunner:
         #                                  PhysicalPlans, per query JSON
         self._mesh = None
         self._active_shards = config.num_shards if config else None
+        self._chip_dispatches: dict = {}  # chip index -> dispatches
         self._wedged = False   # a deadline expired; re-probe before trusting
         self.history = HistoryRing(self.config.history_limit)
         # observability (tpu_olap.obs): span-tree tracer + incremental
@@ -652,6 +653,55 @@ class QueryRunner:
         self._m_cache_entries.set(len(self._plan_cache), cache="plan")
         self._m_cache_entries.set(len(self._arg_cache), cache="arg")
         self.result_cache._refresh_gauges()
+
+    def device_snapshot(self) -> list:
+        """Per-chip serving state behind sys.devices and
+        GET /debug/devices: logical segments owned under the
+        interleaved placement (segment i → chip i mod D), resident
+        device bytes, multi-chip dispatch participation, and tier-1
+        cache-shard entries (chip of an entry = its segment's owner)."""
+        mesh = self.mesh
+        if self.config.platform == "cpu":
+            devs = [None]
+        else:
+            import jax
+            devs = list(mesh.devices.flat) if mesh is not None \
+                else jax.devices()[:1]
+        D = len(devs)
+        seg = [0] * D
+        res_bytes = [0.0] * D
+        rebased_cols = rebase_rows = 0
+        for _name, ds in list(self._datasets.items()):
+            n_seg = len(ds.table.segments)
+            b = ds.resident_bytes()
+            rebased_cols += ds.rebased_cols
+            rebase_rows += ds.rebase_rows_uploaded
+            if mesh is not None and D > 1:
+                for c in range(D):
+                    seg[c] += len(range(c, n_seg, D))
+                    res_bytes[c] += b / D
+            else:
+                seg[0] += n_seg
+                res_bytes[0] += b
+        cache_by_chip = self.result_cache.shard_entries(D)
+        with self._totals_lock:
+            disp = dict(self._chip_dispatches)
+        rows = []
+        for c, d in enumerate(devs):
+            rows.append({
+                "index": c,
+                "device": str(d) if d is not None else "numpy-host",
+                "platform": getattr(d, "platform", "numpy"),
+                "process": getattr(d, "process_index", 0),
+                "chips": D,
+                "segments": seg[c],
+                "resident_bytes": int(res_bytes[c]),
+                "dispatches": disp.get(c, 0),
+                "cache_shard_entries": cache_by_chip.get(c, 0),
+                "rebased_cols": rebased_cols,
+                "rebase_rows_uploaded": rebase_rows,
+            })
+        return rows
 
     def counters(self) -> dict:
         """Aggregate counters, maintained incrementally at record time —
@@ -1267,14 +1317,18 @@ class QueryRunner:
         key = table.name
         ds = self._datasets.get(key)
         if ds is None or ds.table is not table:
-            if ds is not None:
-                # a newer snapshot (append/compaction/re-registration)
-                # replaced this one: release the stale dataset's ledger
-                # accounting — in-flight queries that captured its env
-                # keep their buffers alive by reference
-                ds.evict()
+            prev = ds
+            # the superseded snapshot rides in as `prev`: resident
+            # columns REBASE device-side (only delta-touched segments'
+            # rows upload — docs/INGEST.md "incremental re-place");
+            # evict AFTER construction (the new dataset snapshots the
+            # old stacks first), releasing the stale ledger accounting —
+            # in-flight queries that captured its env keep their
+            # buffers alive by reference
             ds = DeviceDataset(table, self.config.platform, self.mesh,
-                               self._hbm_ledger)
+                               self._hbm_ledger, prev=prev)
+            if prev is not None:
+                prev.evict()
             self._datasets[key] = ds
         return ds
 
@@ -1494,6 +1548,8 @@ class QueryRunner:
     def _run_partials_jax(self, plan: PhysicalPlan,
                           metrics: dict) -> dict:
         import jax
+        if self.mesh is not None:
+            return self._run_partials_mesh(plan, metrics)
         with self._pipeline_slot():
             # stage 1 (enqueue, under dispatch_lock): env build, jit
             # cache, per-call args, and the async dispatch itself —
@@ -1505,20 +1561,14 @@ class QueryRunner:
                 if win is not None:
                     metrics["segments_window"] = win[1]
                 n_seg_full = len(seg_mask)
-                mesh = self.mesh
                 key = plan.fingerprint() \
-                    + ((mesh.devices.size,) if mesh else ()) \
                     + ((win[1],) if win else ())
                 jitted = self._jit_cache.get(key)
                 hit = jitted is not None
                 if hit:
                     _cache_lru_hit(self._jit_cache, key)
                 else:
-                    if mesh is not None:
-                        from tpu_olap.executor.sharding import \
-                            sharded_kernel
-                        jitted = jax.jit(sharded_kernel(plan, mesh))
-                    elif win is not None:
+                    if win is not None:
                         jitted = jax.jit(
                             self._window_kernel(plan.kernel, win[1]))
                     else:
@@ -1526,10 +1576,9 @@ class QueryRunner:
                     self._jit_cache[key] = jitted
                     self._note_compile("partials", metrics)
                 t0 = time.perf_counter()
-                with _span("dispatch", jit_cache_hit=hit,
-                           num_shards=mesh.devices.size if mesh else 1):
+                with _span("dispatch", jit_cache_hit=hit, num_shards=1):
                     consts_dev, seg_arg = self._args_for(plan, seg_mask,
-                                                         mesh)
+                                                         None)
                     out = jitted(env, valid, seg_arg, consts_dev,
                                  win[0]) if win is not None \
                         else jitted(env, valid, seg_arg, consts_dev)
@@ -1540,8 +1589,111 @@ class QueryRunner:
                 out = self._fetch_tree(out, metrics, pin)
         metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
         metrics["jit_cache_hit"] = hit
-        metrics["num_shards"] = mesh.devices.size if mesh else 1
+        metrics["num_shards"] = 1
         return self._embed_windowed_mask(out, plan, win, n_seg_full)
+
+    def _note_chip_dispatch(self, chips):
+        """Per-chip dispatch-participation counters behind sys.devices /
+        GET /debug/devices (dispatch occupancy)."""
+        with self._totals_lock:
+            for c in chips:
+                self._chip_dispatches[c] = \
+                    self._chip_dispatches.get(c, 0) + 1
+
+    def _run_partials_mesh(self, plan: PhysicalPlan,
+                           metrics: dict) -> dict:
+        """Sharded dispatch on `jax.jit` + `NamedSharding` (executor.
+        sharding; docs/TPU_NOTES.md "sharded serving"): columns sit
+        placed per chip (interleaved segment→chip assignment), the
+        per-chip LOCAL window slices each chip's pruned working set,
+        and the merge strategy follows planner.cost — "historicals"
+        brings per-chip unfinalized partials back sharded and merges
+        them at the host broker with the segment-cache algebra;
+        "broker" hands the whole program to GSPMD (replicated outputs,
+        compiler-inserted psum/all-gather). Mask-kind plans (scan/
+        select/search) fetch sharded row masks and inverse-permute the
+        placed segment axis back to logical order."""
+        from tpu_olap.executor import sharding as sh
+        from tpu_olap.planner import cost as cost_mod
+
+        mesh = self.mesh
+        D = mesh.devices.size
+        with self._pipeline_slot():
+            with self._enqueue_lock(metrics):
+                env, valid, seg_mask = self._prepare(plan, metrics)
+                S = len(seg_mask)
+                per_chip = S // D
+                is_agg = plan.kind == "agg" and plan.key_fn is not None
+                strategy = "mask"
+                win = None
+                if is_agg:
+                    with _span("cost-decision") as sp:
+                        decision = cost_mod.decide(plan, self.config, D)
+                        strategy = decision.strategy
+                        # chip-extended keys must fit int32; a dense
+                        # table that large defers to the partitioner
+                        if strategy == "historicals" and \
+                                D * plan.total_groups >= (1 << 31):
+                            strategy = "broker"
+                        # DCN mesh: remote chips' shards are not host-
+                        # addressable, so the broker merge cannot see
+                        # them — GSPMD's replicated merge is the only
+                        # correct spelling across processes
+                        if strategy == "historicals" and \
+                                sh.is_multihost(mesh):
+                            strategy = "broker"
+                        sp.set(strategy=strategy)
+                    metrics["cost"] = decision.to_json()
+                    win = sh.local_window(plan.pruned_ids, D, per_chip) \
+                        if not plan.empty else None
+                    if win is not None:
+                        metrics["segments_window"] = win[1] * D
+                        metrics["segments_window_per_chip"] = win[1]
+                key = plan.fingerprint() + ("mesh", D, strategy,
+                                            win[1] if win else 0)
+                jitted = self._jit_cache.get(key)
+                hit = jitted is not None
+                if hit:
+                    _cache_lru_hit(self._jit_cache, key)
+                else:
+                    if is_agg:
+                        jitted = sh.mesh_agg_kernel(plan, mesh, per_chip,
+                                                    strategy, win)
+                    else:
+                        jitted = sh.mesh_mask_kernel(plan, mesh)
+                    self._jit_cache[key] = jitted
+                    self._note_compile("mesh", metrics)
+                t0 = time.perf_counter()
+                with _span("dispatch", jit_cache_hit=hit, num_shards=D,
+                           strategy=strategy):
+                    consts_dev, seg_arg = self._args_for(plan, seg_mask,
+                                                         mesh)
+                    out = jitted(env, valid, seg_arg, consts_dev,
+                                 win[0]) if win is not None \
+                        else jitted(env, valid, seg_arg, consts_dev)
+                pin = self._pin_inflight(out)
+                self._note_chip_dispatch(range(D))
+            # stage 2, lock-free: ONE device_get pulls every chip's
+            # shard concurrently (per-device transfers overlap)
+            with _span("host-transfer"):
+                out = self._fetch_tree(out, metrics, pin)
+        metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
+        metrics["jit_cache_hit"] = hit
+        metrics["num_shards"] = D
+        if is_agg and strategy == "historicals":
+            with _span("broker-merge", num_shards=D):
+                out = sh.broker_merge(out, plan.agg_plans, D)
+            metrics["merge"] = "broker"
+        elif is_agg:
+            metrics["merge"] = "gspmd"
+        if plan.kind == "mask":
+            # placed -> logical segment order: the scan/select/search
+            # assemblers index rows by GLOBAL logical segment id
+            ds = self._datasets[plan.table.name]
+            m = np.asarray(out["mask"]).reshape(S, -1)
+            out = dict(out)
+            out["mask"] = m[ds.to_place].reshape(-1)
+        return out
 
     def _args_for(self, plan: PhysicalPlan, seg_mask: np.ndarray, mesh):
         """Device copies of the per-call inputs (const pool + segment
@@ -1574,28 +1726,22 @@ class QueryRunner:
         self._arg_cache[ckey] = (consts_dev, seg_arg)
         return consts_dev, seg_arg
 
-    def _packed_jit(self, plan: PhysicalPlan, cap: int, mesh,
-                    strategy: str = "historicals", win=None):
+    def _packed_jit(self, plan: PhysicalPlan, cap: int, win=None):
         """(jitted packed program, layout) for a given group cap.
-        strategy "historicals" = shard_map explicit partials + ICI merge;
-        "broker" = whole program handed to GSPMD (planner.cost). `win`
-        appends the segment-window slice (single-device only)."""
+        Single-device only: packed buffers hold FINALIZED values, which
+        cannot ride the mesh broker merge (partials must stay
+        unfinalized to merge) — mesh dispatch takes _run_partials_mesh
+        instead. `win` appends the segment-window slice."""
         import jax
 
         layout = make_layout(plan, self.config, cap)
-        key = plan.fingerprint() + ("packed", layout.cap, strategy,
-                                    mesh.devices.size if mesh else 1) \
+        key = plan.fingerprint() + ("packed", layout.cap) \
             + ((win[1],) if win else ())
         jitted = self._jit_cache.get(key)
         if jitted is not None:
             _cache_lru_hit(self._jit_cache, key)
         if jitted is None:
-            if mesh is not None and strategy == "historicals":
-                from tpu_olap.executor.sharding import sharded_kernel
-                inner = sharded_kernel(plan, mesh)
-            else:
-                inner = plan.kernel
-            packed = build_packer(inner, plan, layout)
+            packed = build_packer(plan.kernel, plan, layout)
             if win is not None:
                 packed = self._window_kernel(packed, win[1])
             jitted = jax.jit(packed)
@@ -1617,20 +1763,9 @@ class QueryRunner:
                 win = self._segment_window(plan, len(seg_mask))
                 if win is not None:
                     metrics["segments_window"] = win[1]
-                mesh = self.mesh
-                strategy = "historicals"
-                if mesh is not None:
-                    from tpu_olap.planner import cost as cost_mod
-                    with _span("cost-decision") as sp:
-                        decision = cost_mod.decide(plan, self.config,
-                                                   mesh.devices.size)
-                        sp.set(strategy=decision.strategy)
-                    strategy = decision.strategy
-                    metrics["cost"] = decision.to_json()
             cap_limit = min(self.config.result_group_cap,
                             plan.total_groups)
-            base_key = plan.fingerprint() \
-                + (mesh.devices.size if mesh else 1,)
+            base_key = plan.fingerprint() + (1,)
             hint = self._cap_hints.get(base_key)
             cap = cap_limit if hint is None else \
                 min(cap_limit, max(64, _next_pow2(2 * hint)))
@@ -1643,9 +1778,9 @@ class QueryRunner:
                     # re-enters it (rare — the hint adapts)
                     with self._enqueue_lock(metrics):
                         consts_dev, seg_arg = self._args_for(
-                            plan, seg_mask, mesh)
+                            plan, seg_mask, None)
                         jitted, layout, hit = self._packed_jit(
-                            plan, cap, mesh, strategy, win)
+                            plan, cap, win)
                         if not hit:
                             self._note_compile("packed", metrics)
                         buf = jitted(env, valid, seg_arg, consts_dev,
@@ -1665,12 +1800,11 @@ class QueryRunner:
                         dsp.set(jit_cache_hit=hit, overflow=True)
                         return None  # cap exceeded: unpacked re-run
                     cap = min(cap_limit, _next_pow2(count))
-                dsp.set(jit_cache_hit=hit,
-                        num_shards=mesh.devices.size if mesh else 1)
+                dsp.set(jit_cache_hit=hit, num_shards=1)
         self._cap_hints[base_key] = count
         metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
         metrics["jit_cache_hit"] = hit
-        metrics["num_shards"] = mesh.devices.size if mesh else 1
+        metrics["num_shards"] = 1
         metrics["result_groups"] = count
         metrics["result_cap"] = layout.cap
         metrics["packed"] = True
@@ -1741,7 +1875,7 @@ class QueryRunner:
                 cap = min(cap_limit, _next_pow2(count))
             out = {k: np.asarray(v) for k, v in out.items()}
             metrics["num_shards"] = 1
-        elif not use_exchange:
+        elif mesh is None:
             import jax
             # pin the enqueued output tree like every other device path
             # (the caller blocks on the _count probe while the buffers
@@ -1751,7 +1885,7 @@ class QueryRunner:
                 while True:
                     with self._enqueue_lock(metrics):
                         consts_dev, seg_arg = self._args_for(
-                            plan, seg_mask, mesh)
+                            plan, seg_mask, None)
                         key = base_key + (cap,) \
                             + ((win[1],) if win else ())
                         jitted = self._jit_cache.get(key)
@@ -1760,13 +1894,7 @@ class QueryRunner:
                             _cache_lru_hit(self._jit_cache, key)
                         else:
                             kern = plan.make_sparse_kernel(cap)
-                            if mesh is not None:
-                                from tpu_olap.executor.sharding import \
-                                    sharded_sparse_gather_kernel
-                                jitted = jax.jit(
-                                    sharded_sparse_gather_kernel(
-                                        kern, plan, mesh, cap))
-                            elif win is not None:
+                            if win is not None:
                                 jitted = jax.jit(
                                     self._window_kernel(kern, win[1]))
                             else:
@@ -1792,75 +1920,130 @@ class QueryRunner:
             finally:
                 if pin is not None:
                     self._hbm_ledger.unpin_inflight(pin)
-            metrics["num_shards"] = n_shards
+            metrics["num_shards"] = 1
         else:
+            # multi-chip sparse: per-chip FAN-OUT dispatch + broker
+            # merge (docs/TPU_NOTES.md "sharded serving"). Each chip's
+            # resident shard runs the local sort/compact kernel as its
+            # own single-device program (the shards are addressable
+            # arrays — no re-upload, and the D async dispatches
+            # enqueue before any is fetched, so per-chip compute and
+            # transfers overlap); the host broker re-merges the D
+            # compact tables with kernels.sparse_groupby.merge_sparse.
+            # sparse_merge="exchange" lets the broker table hold
+            # D x sparse_group_budget present groups (capacity scales
+            # with chip count); "gather" keeps the legacy global-budget
+            # contract (every group must fit one chip's table).
             import jax
-            from tpu_olap.executor.sharding import \
-                sharded_sparse_exchange_kernel
+
+            from tpu_olap.executor import sharding as sh
+            from tpu_olap.kernels.sparse_groupby import merge_sparse
+            if sh.is_multihost(mesh):
+                # DCN mesh: remote chips' compact tables are not host-
+                # addressable, so neither the fan-out nor the broker
+                # merge can run — hand the WHOLE sparse program to
+                # GSPMD with replicated outputs (global-budget
+                # capacity, like the gather contract)
+                pin = None
+                try:
+                    while True:
+                        with self._enqueue_lock(metrics):
+                            consts_dev, seg_arg = self._args_for(
+                                plan, seg_mask, mesh)
+                            key = base_key + ("gspmd", cap)
+                            jitted = self._jit_cache.get(key)
+                            hit = jitted is not None
+                            if hit:
+                                _cache_lru_hit(self._jit_cache, key)
+                            else:
+                                jitted = jax.jit(
+                                    plan.make_sparse_kernel(cap),
+                                    out_shardings=sh.replicated_spec(
+                                        mesh))
+                                self._jit_cache[key] = jitted
+                                self._note_compile("sparse", metrics)
+                            out = jitted(env, valid, seg_arg,
+                                         consts_dev)
+                            prev, pin = pin, self._pin_inflight(out)
+                        if prev is not None:
+                            self._hbm_ledger.unpin_inflight(prev)
+                        count = int(out["_count"])
+                        if count <= cap:
+                            break
+                        if count > local_limit:
+                            raise UnsupportedAggregation(
+                                f"{count} present groups exceed sparse "
+                                f"budget {local_limit}")
+                        cap = min(local_limit, _next_pow2(count))
+                    out = self._fetch_tree(out, metrics, pin)
+                    pin = None
+                finally:
+                    if pin is not None:
+                        self._hbm_ledger.unpin_inflight(pin)
+                metrics["num_shards"] = n_shards
+                self._cap_hints[base_key] = count
+                metrics["execute_ms"] = \
+                    (time.perf_counter() - t0) * 1000
+                metrics["jit_cache_hit"] = hit
+                metrics["sparse"] = True
+                metrics["result_groups"] = count
+                metrics["result_cap"] = cap
+                return out, count
             lhint = self._cap_hints.get(base_key + ("local",))
             if lhint is not None:
                 cap = min(local_limit, max(64, _next_pow2(2 * lhint)))
-            ohint = self._cap_hints.get(base_key + ("owner",))
-            cap_owner = max(64, _next_pow2(2 * ohint)) if ohint \
-                else max(64, _next_pow2(-(-2 * cap // n_shards)))
-            cap_owner = min(cap_owner, budget)
             pin = None
             try:
                 while True:
                     with self._enqueue_lock(metrics):
                         consts_dev, seg_arg = self._args_for(
                             plan, seg_mask, mesh)
-                        key = base_key + ("x", cap, cap_owner)
+                        key = base_key + ("fanout", cap)
                         jitted = self._jit_cache.get(key)
                         hit = jitted is not None
                         if hit:
                             _cache_lru_hit(self._jit_cache, key)
                         else:
-                            kern = plan.make_sparse_kernel(cap)
-                            jitted = jax.jit(
-                                sharded_sparse_exchange_kernel(
-                                    kern, plan, mesh, cap, cap_owner))
+                            jitted = jax.jit(plan.make_sparse_kernel(cap))
                             self._jit_cache[key] = jitted
                             self._note_compile("sparse", metrics)
-                        out = jitted(env, valid, seg_arg, consts_dev)
-                        prev, pin = pin, self._pin_inflight(out)
+                        chips = sh.chip_args(env, valid, seg_arg,
+                                             consts_dev, mesh)
+                        outs = [jitted(e, v, m, c)
+                                for (e, v, m, c) in chips]
+                        prev, pin = pin, self._pin_inflight(outs)
+                        self._note_chip_dispatch(range(n_shards))
                     if prev is not None:
                         self._hbm_ledger.unpin_inflight(prev)
-                    count = int(out["_count"])
-                    local_max = int(out["_local_max"])
-                    overflow = int(out["_overflow"])
-                    retry = False
-                    if local_max > cap:
-                        if local_max > local_limit:
-                            raise UnsupportedAggregation(
-                                f"{local_max} per-chip present groups "
-                                f"exceed sparse budget {local_limit}")
-                        cap = min(local_limit, _next_pow2(local_max))
-                        retry = True
-                    if overflow:
-                        new_owner = min(budget, _next_pow2(max(
-                            2 * max(count, 1) // n_shards,
-                            2 * cap_owner)))
-                        if new_owner == cap_owner:  # at the clamp
-                            raise UnsupportedAggregation(
-                                f"owner tables overflow the per-chip "
-                                f"sparse budget {budget} ({count}+ "
-                                f"present groups over {n_shards} chips)")
-                        cap_owner = new_owner
-                        retry = True
-                    if not retry:
+                    counts = [int(o["_count"]) for o in outs]
+                    local_max = max(counts)
+                    if local_max <= cap:
                         break
-                out = self._fetch_tree(out, metrics, pin)
+                    if local_max > local_limit:
+                        raise UnsupportedAggregation(
+                            f"{local_max} per-chip present groups "
+                            f"exceed sparse budget {local_limit}")
+                    cap = min(local_limit, _next_pow2(local_max))
+                parts = self._fetch_tree(outs, metrics, pin)
                 pin = None  # consumed (fetch unpins)
             finally:
                 if pin is not None:
                     self._hbm_ledger.unpin_inflight(pin)
+            with _span("broker-merge", num_shards=n_shards):
+                cap_global = min(cap_limit, max(64, _next_pow2(
+                    max(1, sum(counts)))))
+                out = merge_sparse(parts, plan.agg_plans, cap_global,
+                                   np)
+                count = int(out["_count"])
+                if count > cap_limit:
+                    raise UnsupportedAggregation(
+                        f"{count} present groups exceed sparse budget "
+                        f"{cap_limit}")
             self._cap_hints[base_key + ("local",)] = local_max
-            self._cap_hints[base_key + ("owner",)] = \
-                max(64, count // n_shards)
             metrics["num_shards"] = n_shards
-            metrics["sparse_merge"] = "exchange"
-            metrics["result_cap_owner"] = cap_owner
+            if use_exchange:
+                metrics["sparse_merge"] = "exchange"
+                metrics["result_cap_owner"] = cap_global
         self._cap_hints[base_key] = count
         metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
         metrics["jit_cache_hit"] = hit
@@ -1922,7 +2105,10 @@ class QueryRunner:
                 return res
 
         packed = None
-        if self.config.platform != "cpu" and not keep_raw:
+        use_packed = self.config.platform != "cpu" and not keep_raw \
+            and self.mesh is None  # mesh: unfinalized partials only
+        #                            (the broker merge needs them)
+        if use_packed:
             packed = self._dispatch(
                 lambda: self._run_packed(plan, metrics), metrics,
                 table.name)
@@ -1936,7 +2122,7 @@ class QueryRunner:
             with _span("finalize"):
                 arrays = densify(idx, compact, layout, plan.agg_plans)
         else:
-            if self.config.platform != "cpu":
+            if use_packed:
                 metrics["packed"] = False  # cap overflow: unpacked re-run
             partials = self._dispatch(
                 lambda: self._run_partials(plan, metrics), metrics,
@@ -2077,6 +2263,53 @@ class QueryRunner:
                 out = {k: np.asarray(v) for k, v in out.items()}
                 metrics["jit_cache_hit"] = False
                 metrics["num_shards"] = 1
+            elif self.mesh is not None:
+                # mesh variant (docs/CACHING.md "cache shards"): the
+                # per-chip LOCAL window slices each chip's placed
+                # segments, the key extends by placed window position,
+                # and the [D·W·K] table comes back SHARDED per chip —
+                # each (chip, segment) partials entry is cut out on the
+                # host and cached per segment; serving folds cached +
+                # fresh entries at the broker via merge_partials
+                from tpu_olap.executor import sharding as sh
+                mesh = self.mesh
+                D = mesh.devices.size
+                per_chip = S // D
+                lo_l = min(i // D for i in compute_ids)
+                hi_l = max(i // D for i in compute_ids) + 1
+                W = min(_next_pow2(hi_l - lo_l), per_chip)
+                lo_l = min(lo_l, per_chip - W)
+                with self._enqueue_lock(metrics):
+                    jkey = plan.fingerprint() + ("segcache-mesh", D, W)
+                    jitted = self._jit_cache.get(jkey)
+                    hit = jitted is not None
+                    if hit:
+                        _cache_lru_hit(self._jit_cache, jkey)
+                    else:
+                        jitted = sh.mesh_seg_partials_kernel(
+                            plan, mesh, per_chip, W, K)
+                        self._jit_cache[jkey] = jitted
+                        self._note_compile("segcache", metrics)
+                    with _span("dispatch", jit_cache_hit=hit,
+                               segcache=True, num_shards=D):
+                        consts_dev, seg_arg = self._args_for(
+                            plan, seg_mask, mesh)
+                        out = jitted(env, valid, seg_arg, consts_dev,
+                                     lo_l)
+                    pin = self._pin_inflight(out)
+                    self._note_chip_dispatch(range(D))
+                with _span("host-transfer"):
+                    out = self._fetch_tree(out, metrics, pin)
+                metrics["jit_cache_hit"] = hit
+                metrics["num_shards"] = D
+                metrics["execute_ms"] = (time.perf_counter() - t0) * 1000
+                shaped = {name: np.asarray(a).reshape(
+                    (D, W, K) + np.asarray(a).shape[1:])
+                    for name, a in out.items()}
+                # logical sid -> (chip sid mod D, local sid // D)
+                return {sid: {name: a[sid % D, sid // D - lo_l]
+                              for name, a in shaped.items()}
+                        for sid in compute_ids}
             else:
                 import jax
                 W = min(_next_pow2(hi - lo), S)
@@ -2435,11 +2668,21 @@ class QueryRunner:
                         raise AssertionError(
                             "search mask shorter than the segment stack")
                     packed_dev = None
-                    if self.config.platform != "cpu":
+                    if self.config.platform != "cpu" \
+                            and ds.to_logical is None:
                         packed_dev = _search_counts_packed(
                             cards, dev_mask.reshape(-1)[:n_flat], cols)
                 if packed_dev is None:
                     m = np.asarray(dev_mask).reshape(-1)[:n_flat]
+                    if ds.to_logical is not None:
+                        # mesh: the fetched mask was inverse-permuted to
+                        # LOGICAL segment order, but the resident column
+                        # stacks sit in PLACEMENT order — re-permute so
+                        # mask and codes walk the same rows (bincounts
+                        # are order-insensitive, consistency is all
+                        # that matters)
+                        m = m.reshape(len(ds.to_logical), -1)[
+                            ds.to_logical].reshape(-1)
                     packed = np.concatenate(
                         [np.bincount(np.asarray(c).reshape(-1)[m],
                                      minlength=card + 1)

@@ -10,22 +10,28 @@ scan/transport/merge costs and knobs like `histMergeFactor` /
 The TPU translation keeps the same decision shape with the same inputs:
 
 - "**broker**"  -> hand the WHOLE jitted program to XLA's GSPMD
-  partitioner over the mesh: one logical program, compiler-inserted
-  collectives (the fan-out/merge is opaque, like Druid's broker).
-- "**historicals**" -> `shard_map` the segment axis: each chip computes an
-  explicit partial dense group table over its local segments and the
-  merge is an explicit psum/pmin/pmax over ICI (the analog of per-
-  historical partial aggregates + Spark's final merge-aggregate,
-  SURVEY.md §3.5 P2).
+  partitioner over the mesh: plain group keys, replicated outputs,
+  compiler-inserted psum/all-gather (the fan-out/merge is opaque, like
+  Druid's broker). The only strategy on a multi-host (DCN) mesh, where
+  remote shards are not host-addressable.
+- "**historicals**" -> chip-extended group keys under
+  `jax.jit(..., out_shardings=P('chips'))`: each chip's explicit
+  partial dense group table stays SHARDED in its own HBM (zero
+  cross-chip traffic in the reduce), one fetch pulls every chip's
+  shard concurrently, and the host BROKER merges the D unfinalized
+  tables with the segment-cache algebra (the analog of per-historical
+  partial aggregates + Spark's final merge-aggregate, SURVEY.md §3.5
+  P2; executor/sharding.py).
 
-Explicit partials pay exactly one [K]-table allreduce, so they win while
-the group table is small relative to the scan; a huge dense table (K
-within the dense budget but millions of groups x several aggregators)
-makes the fixed-size allreduce dominate, where the compiler's freedom to
-schedule (reduce-scatter, fusion into the scatter) is worth more. Both
-strategies are semantically identical — this model only picks the faster
-one, and `EngineConfig.cost_model_enabled=False` pins "historicals"
-(the reference's default fan-out path).
+Explicit partials pay the [D·K] host merge instead of a device
+collective, so they win while the group table is small relative to the
+scan; a huge dense table (K within the dense budget but millions of
+groups x several aggregators) makes the fixed-size merge dominate,
+where the compiler's freedom to schedule (reduce-scatter, fusion into
+the scatter) is worth more. Both strategies are semantically identical
+— this model only picks the faster one, and
+`EngineConfig.cost_model_enabled=False` pins "historicals" (the
+reference's default fan-out path).
 
 Constants are per-chip throughput guesses, deliberately coarse — the
 decision only needs the crossover magnitude, and every term is exposed in
@@ -85,7 +91,8 @@ def constants(config) -> dict:
 
 @dataclass(frozen=True)
 class CostDecision:
-    strategy: str            # "historicals" (shard_map) | "broker" (gspmd)
+    strategy: str            # "historicals" (sharded partials + host
+    #                           broker merge) | "broker" (GSPMD)
     shards: int
     rows_scanned: int
     groups: int

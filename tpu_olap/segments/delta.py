@@ -435,15 +435,18 @@ def _compact_incremental(table: TableSegments):
     rebuilt = ing.finalize()
     merged = []
     for s in untouched:
-        # fresh meta with the merged id; column arrays AND the spill
-        # memo are shared — the live snapshot's segment objects must
-        # never be mutated (queries hold them)
+        # fresh meta with the merged id; column arrays, the spill memo
+        # AND the identity uid are shared — the live snapshot's segment
+        # objects must never be mutated (queries hold them), while the
+        # carried uid keeps tier-1 cache entries and device-resident
+        # rows valid for the untouched partition (segment_cache_token /
+        # DeviceDataset rebase both key on it)
         ns = Segment(SegmentMeta(
             segment_id=0, n_valid=s.meta.n_valid,
             time_min=s.meta.time_min, time_max=s.meta.time_max,
             column_min=dict(s.meta.column_min),
             column_max=dict(s.meta.column_max)),
-            s.columns, s.null_masks)
+            s.columns, s.null_masks, uid=s.uid)
         memo = getattr(s, "_spill_memo", None)
         if memo is not None:
             ns._spill_memo = memo
@@ -1106,10 +1109,15 @@ class IngestManager:
                     folded = [pd.concat(folded, ignore_index=True)]
                 st.frames = folded + keep
                 st.frames_version += 1
-            # the sealed set changed: BOTH cache tiers for this table
-            # are stale at key level — purge eagerly; cubes over it are
-            # stale too, the maintainer rebuilds them
-            runner.result_cache.invalidate_table(name)
+            # the sealed set changed: tier 2 is stale at key level
+            # (purged eagerly), but tier-1 entries of UNTOUCHED
+            # partitions stay live — incremental compaction carries
+            # their Segment uids, so only delta-touched partitions'
+            # entries drop (executor.resultcache.invalidate_compacted);
+            # cubes over the table are stale, the maintainer rebuilds
+            live = {merged.segment_cache_token(i)
+                    for i in range(len(merged.segments))}
+            runner.result_cache.invalidate_compacted(name, live)
             self._m_compact.inc(table=name)
             self._m_delta.set(merged.delta_rows, table=name)
             self._observe_drain(st, d_snap, st.last_compact_ms)

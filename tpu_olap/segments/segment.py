@@ -9,6 +9,7 @@ pruning, and table-level schema/dictionaries.
 from __future__ import annotations
 
 import enum
+import itertools as _itertools
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -63,6 +64,9 @@ class SegmentMeta:
                 "columnMax": dict(self.column_max)}
 
 
+_SEG_UID = _itertools.count(1)
+
+
 @dataclass
 class Segment:
     """One fixed-size block of rows. All column arrays have block_rows
@@ -72,6 +76,11 @@ class Segment:
     meta: SegmentMeta
     columns: dict  # name -> np.ndarray (int32 codes | int64 | float64)
     null_masks: dict  # name -> bool array, only for numeric cols with nulls
+    # process-unique identity stamp: snapshots that SHARE a segment by
+    # object (delta-only appends, incremental compaction's untouched
+    # partitions) share the uid, so per-segment cache state keyed on it
+    # survives exactly as long as the data is literally the same block
+    uid: int = field(default_factory=lambda: next(_SEG_UID))
 
     @property
     def block_rows(self) -> int:
@@ -135,6 +144,19 @@ class TableSegments:
         — their contents change block-in-place across snapshots)."""
         return self.sealed_generation if sid < self.sealed_count \
             else self.generation
+
+    def segment_cache_token(self, sid: int) -> tuple:
+        """Tier-1 cache key component for one segment. Sealed segments
+        use their Segment uid — identity-stable across delta-only
+        appends AND incremental compaction (untouched calendar
+        partitions share the object into the new sealed set), so a
+        partition-aligned compaction invalidates ONLY the delta-touched
+        partitions' entries (under a mesh: only the affected chip's
+        cache shard). Delta blocks take the snapshot generation (each
+        append re-keys them; they are never cached anyway)."""
+        if sid < self.sealed_count:
+            return ("u", self.segments[sid].uid)
+        return ("g", self.generation)
 
     def delta_ids(self) -> list:
         return list(range(self.sealed_count, len(self.segments)))
