@@ -1,37 +1,57 @@
-"""Multi-chip execution: segment-sharded data parallelism over a Mesh.
+"""Multi-chip execution on `jax.jit` + `NamedSharding` (no shard_map).
 
 The TPU-native replacement for the reference's direct-historical fan-out
-(SURVEY.md §3.5 P2): segments shard across chips on a 1-D 'data' mesh axis
-(the analog of one partition per historical), each chip computes partial
-dense group tables over its local segments, and the "Spark final merge
-aggregate" becomes XLA collectives over ICI — psum for sums/counts, pmax/
-pmin for extremes and HLL registers, an all_gather + fold for theta
-sketches (SURVEY.md §3.6 transport summary; BASELINE.json:5 "partial
-aggregates allreduce over ICI").
+(SURVEY.md §3.5 P2), rebuilt on the modern JAX API: columns are placed
+ONCE with `jax.device_put(x, NamedSharding(mesh, P(AXIS)))` over an
+INTERLEAVED segment→chip assignment (segment i → chip i mod D, the way a
+Druid coordinator balances an interval's segments across historicals),
+and group-reduce kernels compile with `jax.jit(..., out_shardings=...)`
+so XLA's GSPMD partitioner inserts the cross-chip collectives the old
+`jax.shard_map` code spelled by hand.
 
-The dense group table is what makes this an allreduce instead of a hash
-exchange: group ids are global (dictionary codes × calendar buckets), so no
-chip ever needs another chip's rows — only its [K] table. High-cardinality
-GROUP BY beyond the dense budget takes the sparse (sort-based) path, whose
-multi-chip merge is a **hash exchange** (SURVEY.md §3.5 last row, §8.4 #1):
-each chip compacts its local groups, entries route to a key-hash owner chip
-over an ICI all_to_all, and each owner merges only its own keys — so
-present-group capacity scales with chip count (D × per-chip budget when
-keys distribute) and per-chip merge work stays O(global/D), unlike the
-legacy gather-everything strategy (sharded_sparse_gather_kernel, kept as
-EngineConfig.sparse_merge="gather").
+Two dense merge strategies (planner.cost picks per query, same decision
+shape as the reference's broker-vs-direct-historicals choice):
+
+- "historicals": the group key is EXTENDED by the owning chip id, the
+  [D·K] partial table comes back sharded per chip (each chip's K-block
+  lives in its own HBM — zero cross-chip traffic in the reduce), and a
+  host-side **broker** step merges the D unfinalized partial tables
+  with the exact algebra the segment cache and cube folds already share
+  (kernels.groupby.merge_partials / partials_radix). One device fetch
+  pulls every chip's shard concurrently, so stage-2 transfers overlap
+  across chips.
+- "broker": the WHOLE program is handed to GSPMD — plain group keys,
+  replicated outputs, compiler-inserted psum/all-gather (the fan-out/
+  merge is opaque, like Druid's broker).
+
+Interleaved placement is what makes windowed dispatch prune PER-CHIP
+working sets (docs/TPU_NOTES.md): a contiguous time range of logical
+segments [lo, hi) lands on every chip as the LOCAL range
+[lo//D, ceil(hi/D)), so the kernel reshapes [S, R] → [D, S/D, R]
+(sharded on the chip axis) and dynamic-slices the local axis — each chip
+reads only its ~(hi-lo)/D pruned segments, with no cross-chip data
+movement and ONE compiled program per (template, local width).
+
+High-cardinality sparse group-by fans out as true per-chip programs:
+each chip's resident shard (an addressable single-device array — no
+re-upload) runs the local sort/compact kernel, the D dispatches enqueue
+asynchronously and fetch together, and the host broker re-merges the
+compact tables (kernels.sparse_groupby.merge_sparse). Present-group
+capacity under sparse_merge="exchange" is D × the per-chip budget —
+the broker holds the union, so capacity scales with chip count.
 """
 
 from __future__ import annotations
 
+import functools
+
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpu_olap.kernels import theta as theta_mod
-
-DATA_AXIS = "data"
+AXIS = "chips"
+# legacy alias (pre-rewrite name for the 1-D segment axis)
+DATA_AXIS = AXIS
 
 
 def make_mesh(num_shards: int) -> Mesh:
@@ -39,256 +59,261 @@ def make_mesh(num_shards: int) -> Mesh:
     if num_shards > len(devs):
         raise ValueError(
             f"num_shards={num_shards} exceeds {len(devs)} devices")
-    return Mesh(np.array(devs[:num_shards]), (DATA_AXIS,))
-
-
-def merge_collective(out: dict, agg_plans, axis: str = DATA_AXIS) -> dict:
-    """Merge per-chip partial aggregates across the mesh axis — the same
-    ops as kernels.groupby.merge_partials, as collectives."""
-    merged = {"_rows": jax.lax.psum(out["_rows"], axis)}
-    for p in agg_plans:
-        v = out[p.name]
-        if p.kind in ("count", "sum"):
-            merged[p.name] = jax.lax.psum(v, axis)
-        elif p.kind == "min":
-            merged[p.name] = jax.lax.pmin(v, axis)
-        elif p.kind in ("max", "hll"):
-            merged[p.name] = jax.lax.pmax(v, axis)
-        elif p.kind == "theta":
-            g = jax.lax.all_gather(v, axis)  # [D, K, k]
-            acc = g[0]
-            for i in range(1, g.shape[0]):
-                acc = theta_mod.theta_merge(acc, g[i], jnp)
-            merged[p.name] = acc
-        else:
-            raise AssertionError(p.kind)
-        nn = f"_nn_{p.name}"
-        if nn in out:
-            merged[nn] = jax.lax.psum(out[nn], axis)
-    return merged
-
-
-def sharded_kernel(plan, mesh: Mesh):
-    """Wrap a PhysicalPlan kernel in shard_map over the segment axis.
-
-    Inputs arrive sharded on their leading (segment) dim; consts are
-    replicated; outputs are replicated merged tables (every chip holds the
-    final answer — the host reads one copy).
-    """
-    kernel = plan.kernel
-    agg_plans = plan.agg_plans
-    is_mask = plan.kind == "mask"
-
-    def local(env, valid, seg_mask, consts):
-        out = kernel(env, valid, seg_mask, consts)
-        if is_mask:
-            return out  # row masks stay sharded; host gathers per shard
-        return merge_collective(out, agg_plans)
-
-    def specs_like(env):
-        return {
-            "cols": {k: P(DATA_AXIS) for k in env["cols"]},
-            "nulls": {k: P(DATA_AXIS) for k in env["nulls"]},
-        }
-
-    def run(env, valid, seg_mask, consts):
-        f = jax.shard_map(
-            local, mesh=mesh,
-            in_specs=(specs_like(env), P(DATA_AXIS), P(DATA_AXIS),
-                      jax.tree.map(lambda _: P(), consts)),
-            out_specs=(jax.tree.map(lambda _: P(DATA_AXIS), {"mask": 0})
-                       if is_mask else P()),
-            # the theta merge (all_gather + fold) is replicated by
-            # construction but defeats static replication inference
-            check_vma=False,
-        )
-        return f(env, valid, seg_mask, consts)
-
-    return run
-
-
-def sharded_sparse_gather_kernel(kernel, plan, mesh: Mesh, cap: int):
-    """Legacy sparse merge: each chip reduces its local segments to a
-    compacted [cap] table, tables all_gather over ICI, and every chip
-    re-merges the full [D, cap] concatenation. Simple and fine for small
-    D·cap; superseded by the hash exchange below for scale (every chip
-    pays O(D·cap) transfer + re-sort, and cap must hold ALL groups)."""
-    from tpu_olap.kernels.sparse_groupby import merge_sparse
-
-    agg_plans = plan.agg_plans
-
-    def local(env, valid, seg_mask, consts):
-        out = kernel(env, valid, seg_mask, consts)
-        gathered = {k: jax.lax.all_gather(v, DATA_AXIS)
-                    for k, v in out.items()}
-        n = mesh.devices.size
-        parts = [{k: gathered[k][d] for k in out} for d in range(n)]
-        return merge_sparse(parts, agg_plans, cap, jnp)
-
-    def specs_like(env):
-        return {
-            "cols": {k: P(DATA_AXIS) for k in env["cols"]},
-            "nulls": {k: P(DATA_AXIS) for k in env["nulls"]},
-        }
-
-    def run(env, valid, seg_mask, consts):
-        f = jax.shard_map(
-            local, mesh=mesh,
-            in_specs=(specs_like(env), P(DATA_AXIS), P(DATA_AXIS),
-                      jax.tree.map(lambda _: P(), consts)),
-            out_specs=P(),
-            check_vma=False,  # replicated by construction post-gather
-        )
-        return f(env, valid, seg_mask, consts)
-
-    return run
-
-
-def bucket_cap(cap_local: int, num_shards: int) -> int:
-    """Send-bucket slots per destination chip: expected load is
-    cap_local/D under a uniform key hash; 2x headroom absorbs skew."""
-    return max(64, -(-2 * cap_local // num_shards))
-
-
-def _owner_of(keys, num_shards: int, jnp):
-    """Key-hash owner chip (Fibonacci multiplicative hash over the int64
-    mixed-radix key; the multiplier is 2^64/φ as a signed int64)."""
-    h = keys * jnp.int64(-7046029254386353131)
-    h = (h >> jnp.int64(33)) & jnp.int64(0x7FFFFFFF)
-    return (h % jnp.int64(num_shards)).astype(jnp.int32)
-
-
-def sharded_sparse_exchange_kernel(kernel, plan, mesh: Mesh,
-                                   cap_local: int, cap_owner: int):
-    """Hash-exchange sparse merge (SURVEY.md §3.5 last row; §8.4 #1;
-    PAPERS.md "partial partial aggregates" shape):
-
-      1. each chip compacts its local rows to a sorted [cap_local] group
-         table (the pre-aggregation — row counts never cross ICI);
-      2. every entry routes to owner = hash(key) % D: entries scatter
-         into a [D, B] send buffer (B = bucket_cap) and swap via ONE
-         lax.all_to_all over ICI — each chip transfers O(cap_local), not
-         O(D·cap) like the gather strategy;
-      3. each owner merges only its own keys into a [cap_owner] table —
-         per-chip merge work is O(global/D), and total capacity is
-         D × cap_owner: present-group cardinality scales with chip count.
-
-    Outputs stay sharded on the owner axis (the host reads [D·cap_owner]
-    slot arrays; empty slots carry SENTINEL keys). Scalars:
-    `_count` = true global distinct, `_local_max` = max per-chip local
-    distinct (sizes cap_local retries), `_overflow` = 1 if any send
-    bucket or owner table overflowed (sizes cap_owner retries).
-    """
-    from tpu_olap.kernels.sparse_groupby import SENTINEL, merge_sparse
-
-    D = mesh.devices.size
-    B = bucket_cap(cap_local, D)
-    agg_plans = plan.agg_plans
-
-    def local(env, valid, seg_mask, consts):
-        out = kernel(env, valid, seg_mask, consts)
-        keys = out["_keys"]
-        present = keys != SENTINEL
-        owner = jnp.where(present, _owner_of(keys, D, jnp), D)
-
-        # rank of each entry within its owner bucket: stable sort by
-        # owner, then index minus a cummax of segment starts
-        idx = jnp.arange(cap_local, dtype=jnp.int32)
-        owner_s, order = jax.lax.sort((owner, idx), num_keys=1)
-        boundary = jnp.concatenate(
-            [jnp.ones((1,), bool), owner_s[1:] != owner_s[:-1]])
-        seg_start = jax.lax.cummax(jnp.where(boundary, idx, 0))
-        pos = jnp.zeros((cap_local,), jnp.int32) \
-            .at[order].set(idx - seg_start)
-
-        ok = present & (pos < B)
-        send_overflow = (present & (pos >= B)).sum(dtype=jnp.int32)
-        flat = jnp.where(ok, owner * B + jnp.minimum(pos, B - 1), D * B)
-
-        def scatter(v, fill):
-            buf = jnp.full((D * B + 1,) + v.shape[1:], fill, v.dtype)
-            buf = buf.at[flat].set(v, mode="drop")
-            return buf[:D * B].reshape((D, B) + v.shape[1:])
-
-        sent = {"_keys": scatter(keys, SENTINEL)}
-        for name, v in out.items():
-            if name in ("_keys", "_count"):
-                continue
-            sent[name] = scatter(v, np.zeros((), v.dtype))
-
-        recv = {name: jax.lax.all_to_all(v, DATA_AXIS, split_axis=0,
-                                         concat_axis=0, tiled=True)
-                for name, v in sent.items()}
-        parts = [{k: recv[k][d] for k in recv} for d in range(D)]
-        merged = merge_sparse(parts, agg_plans, cap_owner, jnp)
-
-        owner_count = merged["_count"]
-        merged["_count"] = jax.lax.psum(
-            jnp.minimum(owner_count, cap_owner), DATA_AXIS)
-        merged["_local_max"] = jax.lax.pmax(out["_count"], DATA_AXIS)
-        merged["_overflow"] = jax.lax.pmax(
-            ((owner_count > cap_owner) | (send_overflow > 0))
-            .astype(jnp.int32), DATA_AXIS)
-        return merged
-
-    def specs_like(env):
-        return {
-            "cols": {k: P(DATA_AXIS) for k in env["cols"]},
-            "nulls": {k: P(DATA_AXIS) for k in env["nulls"]},
-        }
-
-    def run(env, valid, seg_mask, consts):
-        scalar = {"_count", "_local_max", "_overflow"}
-        names = (["_keys", "_rows", "_count", "_local_max", "_overflow"]
-                 + [p.name for p in agg_plans]
-                 + [f"_nn_{p.name}" for p in agg_plans
-                    if p.kind in ("min", "max")])
-        f = jax.shard_map(
-            local, mesh=mesh,
-            in_specs=(specs_like(env), P(DATA_AXIS), P(DATA_AXIS),
-                      jax.tree.map(lambda _: P(), consts)),
-            out_specs={n: (P() if n in scalar else P(DATA_AXIS))
-                       for n in names},
-            check_vma=False,
-        )
-        return f(env, valid, seg_mask, consts)
-
-    return run
-
-
-def shard_put(arr: np.ndarray, mesh: Mesh):
-    """Host array -> device array sharded on the leading axis.
-
-    Uses make_array_from_callback, the multi-host-correct formulation:
-    each process materializes only the shards addressable on ITS devices
-    (on a single host this degenerates to a plain sharded device_put).
-    With a multi-host mesh (jax.distributed initialized and make_mesh
-    over global devices), every host feeds its local slice of the
-    segment axis — no host ever holds the whole table (SURVEY.md §3.6:
-    ICI within a slice, DCN across)."""
-    sharding = NamedSharding(mesh, P(DATA_AXIS))
-    return jax.make_array_from_callback(
-        arr.shape, sharding, lambda idx: arr[idx])
+    return Mesh(np.array(devs[:num_shards]), (AXIS,))
 
 
 def make_multihost_mesh(num_shards: int | None = None) -> Mesh:
     """Mesh over ALL processes' devices (call after
     jax.distributed.initialize on every host). Single-process callers
     get the same mesh make_mesh builds; multi-host callers get a 1-D
-    segment axis spanning hosts — psum/all_to_all then ride ICI within a
-    slice and DCN across slices, with no code change in the kernels."""
+    chip axis spanning hosts — GSPMD's inserted collectives then ride
+    ICI within a slice and DCN across slices with no code change."""
     devs = jax.devices()
     n = num_shards or len(devs)
     if n > len(devs):
         raise ValueError(f"num_shards={n} exceeds {len(devs)} devices")
-    return Mesh(np.array(devs[:n]), (DATA_AXIS,))
-
-
-def replicate_put(arr, mesh: Mesh):
-    return jax.device_put(arr, NamedSharding(mesh, P()))
+    return Mesh(np.array(devs[:n]), (AXIS,))
 
 
 def pad_segments(n_segments: int, num_shards: int) -> int:
-    """Segments must split evenly across shards; padded blocks are fully
+    """Segments must split evenly across chips; padded blocks are fully
     invalid rows (valid mask False), so results are unaffected."""
     return -(-n_segments // num_shards) * num_shards
+
+
+def placement(n_segments: int, num_shards: int):
+    """(to_place, to_logical) permutations for the interleaved
+    segment→chip assignment over a PADDED segment count.
+
+    Logical segment i belongs to chip i mod D at local index i // D;
+    the placed (device) order is chip-major, so chip c's contiguous
+    NamedSharding block [c·S/D, (c+1)·S/D) holds exactly its
+    interleaved segments. to_place[i] = placed position of logical i;
+    to_logical[p] = logical id at placed position p."""
+    per_chip = n_segments // num_shards
+    logical = np.arange(n_segments, dtype=np.int64)
+    to_place = (logical % num_shards) * per_chip + logical // num_shards
+    to_logical = np.empty(n_segments, np.int64)
+    to_logical[to_place] = logical
+    return to_place.astype(np.int32), to_logical.astype(np.int32)
+
+
+def chip_of(segment_id: int, num_shards: int) -> int:
+    """Owning chip of a logical segment under interleaved placement."""
+    return segment_id % num_shards
+
+
+def is_multihost(mesh: Mesh) -> bool:
+    """True when the mesh spans processes (DCN): remote shards are not
+    addressable, so the host broker merge and per-chip fan-out cannot
+    see them — those paths force the GSPMD spellings (replicated
+    outputs, compiler-inserted collectives) instead."""
+    return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
+def shard_spec(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(AXIS))
+
+
+def replicated_spec(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_put(arr: np.ndarray, mesh: Mesh):
+    """Host array (PLACEMENT order on the leading axis) -> device array
+    sharded per chip.
+
+    Uses make_array_from_callback, the multi-host-correct formulation:
+    each process materializes only the shards addressable on ITS devices
+    (on a single host this degenerates to a plain sharded device_put).
+    With a multi-host mesh every host feeds its local slice of the
+    placed segment axis — no host ever holds the whole table."""
+    sharding = shard_spec(mesh)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx])
+
+
+def replicate_put(arr, mesh: Mesh):
+    return jax.device_put(arr, replicated_spec(mesh))
+
+
+def chip_shards(arr, mesh: Mesh) -> list:
+    """Per-chip single-device views of a sharded (or replicated) array,
+    in mesh order — each is a committed jax.Array resident on its chip,
+    usable directly as an input to a per-device jitted program (the
+    sparse fan-out path). No copies: the shards are the same buffers
+    the sharded array owns."""
+    by_dev = {s.device: s.data for s in arr.addressable_shards}
+    return [by_dev[d] for d in mesh.devices.flat]
+
+
+def chip_args(env, valid, seg_mask, consts, mesh: Mesh) -> list:
+    """Per-chip (env, valid, seg_mask, consts) argument tuples for the
+    sparse fan-out dispatch: sharded arrays split into their resident
+    per-device shards, replicated consts resolve to each chip's copy —
+    every piece is already on its chip, so the D single-device programs
+    launch with zero re-upload."""
+    D = mesh.devices.size
+    cols = {k: chip_shards(v, mesh) for k, v in env["cols"].items()}
+    nulls = {k: chip_shards(v, mesh) for k, v in env["nulls"].items()}
+    vs = chip_shards(valid, mesh)
+    ms = chip_shards(seg_mask, mesh)
+    cs = {k: chip_shards(v, mesh) for k, v in consts.items()}
+    return [({"cols": {k: cols[k][c] for k in cols},
+              "nulls": {k: nulls[k][c] for k in nulls}},
+             vs[c], ms[c], {k: cs[k][c] for k in cs})
+            for c in range(D)]
+
+
+def local_window(pruned_ids, num_shards: int, per_chip: int):
+    """(lo_local, W_local) covering every pruned segment's LOCAL index
+    on its chip, or None when windowing would not save >= 25% of the
+    per-chip working set. Interleaved placement makes the local ranges
+    near-identical across chips, so ONE (lo, W) serves all of them —
+    the per-chip analog of QueryRunner._segment_window. `lo` is traced
+    at dispatch, so a sliding interval of the same width re-uses the
+    compiled program."""
+    if not pruned_ids:
+        return None
+    lo = min(pruned_ids) // num_shards
+    hi = max(pruned_ids) // num_shards + 1
+    W = _next_pow2(hi - lo)
+    W = min(W, per_chip)
+    if 4 * W >= 3 * per_chip:
+        return None
+    return min(lo, per_chip - W), W
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, int(n) - 1).bit_length() if n > 1 else 1
+
+
+def _slice_local(a, D: int, per_chip: int, lo, W: int):
+    """[D·per_chip, ...] -> [D·W, ...]: reshape the placed segment axis
+    to (chip, local), dynamic-slice the LOCAL axis (unsharded — GSPMD
+    slices per chip with no communication), flatten back."""
+    a3 = a.reshape((D, per_chip) + a.shape[1:])
+    w = jax.lax.dynamic_slice_in_dim(a3, lo, W, axis=1)
+    return w.reshape((D * W,) + a.shape[1:])
+
+
+def _window_env(env, valid, seg_mask, D, per_chip, lo, W):
+    sl = functools.partial(_slice_local, D=D, per_chip=per_chip,
+                           lo=lo, W=W)
+    wenv = {"cols": {c: sl(a) for c, a in env["cols"].items()},
+            "nulls": {c: sl(a) for c, a in env["nulls"].items()}}
+    return wenv, sl(valid), sl(seg_mask)
+
+
+def chip_extended_key(key, mask, D: int, blocks: int, K: int):
+    """Group key extended by the owning chip (placement order: row
+    block b belongs to chip b // blocks), so the [D·K] partial table
+    shards per chip with zero cross-chip reduce traffic. THE one
+    definition shared by the single-query mesh kernel and the fused
+    batch legs — the key layout must never drift between them (a
+    drift would silently de-synchronize fused-batch results from
+    single-query mesh results)."""
+    import jax.numpy as jnp
+
+    r = mask.shape[0] // (D * blocks)
+    chip = jnp.repeat(
+        jnp.arange(D * blocks, dtype=jnp.int32) // jnp.int32(blocks), r)
+    return chip * jnp.int32(K) + key.astype(jnp.int32)
+
+
+def mesh_agg_kernel(plan, mesh: Mesh, per_chip: int, strategy: str,
+                    win=None):
+    """Jitted dense-aggregation program over the mesh.
+
+    strategy "historicals": chip-extended group keys -> [D·K] partials,
+    out_shardings=P(chips) so each chip's K-block stays in its own HBM
+    (the host broker merges). strategy "broker": plain keys ->
+    replicated [K] outputs, GSPMD inserts the cross-chip psum/
+    all-gather merges. Both run the plan's GENERIC key_fn front half
+    (the Pallas kernel is a single-chip program; under a mesh the
+    shared jnp path serves every chip identically).
+
+    Signature matches the single-device jit paths:
+    fn(env, valid, seg_mask, consts[, lo_local]) with `lo_local` traced
+    when a per-chip window is active."""
+    from tpu_olap.kernels.groupby import group_reduce
+
+    D = mesh.devices.size
+    K = plan.total_groups
+    W = win[1] if win is not None else per_chip
+    historicals = strategy == "historicals"
+
+    def body(env, valid, seg_mask, consts, lo=None):
+        if lo is not None:
+            env, valid, seg_mask = _window_env(env, valid, seg_mask,
+                                               D, per_chip, lo, W)
+        fenv, mask, key = plan.key_fn(env, valid, seg_mask, consts)
+        if not historicals:
+            return group_reduce(key, mask, fenv, plan.agg_plans, K,
+                                consts)
+        key2 = chip_extended_key(key, mask, D, W, K)
+        return group_reduce(key2, mask, fenv, plan.agg_plans, D * K,
+                            consts)
+
+    out = shard_spec(mesh) if historicals else replicated_spec(mesh)
+    if win is not None:
+        return jax.jit(lambda e, v, m, c, lo: body(e, v, m, c, lo),
+                       out_shardings=out)
+    return jax.jit(lambda e, v, m, c: body(e, v, m, c),
+                   out_shardings=out)
+
+
+def mesh_mask_kernel(plan, mesh: Mesh):
+    """Jitted row-mask program (scan/select/search): the plan's own
+    kernel handed whole to GSPMD, outputs sharded per chip — the host
+    fetch pulls each chip's rows concurrently, then inverse-permutes
+    the placed segment axis back to logical order (runner side). On a
+    multi-host mesh the mask replicates instead (every host must
+    assemble the full row set)."""
+    out = replicated_spec(mesh) if is_multihost(mesh) \
+        else shard_spec(mesh)
+    return jax.jit(plan.kernel, out_shardings=out)
+
+
+def mesh_seg_partials_kernel(plan, mesh: Mesh, per_chip: int, W: int,
+                             K: int):
+    """Per-(chip, segment) partials in one mesh program: local-window
+    slice, then the group key extends by the PLACED window position, so
+    the [D·W·K] table comes back sharded per chip and splits into one
+    mergeable partials dict per computed segment — the tier-1 cache
+    shard entries the broker merge folds (docs/CACHING.md)."""
+    import jax.numpy as jnp
+
+    from tpu_olap.kernels.groupby import group_reduce
+
+    D = mesh.devices.size
+
+    def fn(env, valid, seg_mask, consts, lo):
+        env, valid, seg_mask = _window_env(env, valid, seg_mask,
+                                           D, per_chip, lo, W)
+        fenv, mask, key = plan.key_fn(env, valid, seg_mask, consts)
+        r = mask.shape[0] // (D * W)
+        pos = jnp.repeat(jnp.arange(D * W, dtype=jnp.int32), r)
+        key2 = pos * jnp.int32(K) + key.astype(jnp.int32)
+        return group_reduce(key2, mask, fenv, plan.agg_plans, D * W * K,
+                            consts)
+
+    return jax.jit(fn, out_shardings=shard_spec(mesh))
+
+
+def broker_merge(out: dict, agg_plans, num_shards: int) -> dict:
+    """Host-side broker step: {name: [D·K, ...]} per-chip unfinalized
+    partial tables -> one merged [K, ...] partials dict, folded with
+    the exact merge algebra the segment cache and cube serves share
+    (kernels.groupby.merge_partials: sums add, min/max fold, HLL
+    registers max-merge, theta tables re-merge losslessly)."""
+    from tpu_olap.kernels.groupby import merge_partials
+
+    parts = []
+    for d in range(num_shards):
+        parts.append({
+            name: np.asarray(v).reshape(
+                (num_shards, -1) + np.asarray(v).shape[1:])[d]
+            for name, v in out.items()})
+    return functools.reduce(
+        lambda a, b: merge_partials(a, b, agg_plans), parts)
