@@ -108,6 +108,11 @@ def test_chaos_recovery_parity():
     inj = _run_chaos(n_queries=50, seed=7)
     # the sweep should have hit more than one stage to mean anything
     assert len(inj.by_stage) >= 2, inj.by_stage
+    # stages=None opts into the per-stage-boundary sites too (ISSUE 16:
+    # plan/enqueue/transfer/finalize/assemble) — the stage graph must
+    # survive faults at its own seams, not just inside the legacy sites
+    assert any(s.startswith("stage-") for s in inj.by_stage), \
+        inj.by_stage
 
 
 @pytest.mark.slow

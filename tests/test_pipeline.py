@@ -51,7 +51,7 @@ def _reference():
 
 def test_pipelined_is_default_and_matches_serialized():
     eng = Engine()
-    assert eng.config.pipeline_depth == 2
+    assert eng.config.pipeline_depth == 4
     _register(eng)
     got = eng.sql(SQL)
     rec = eng.runner.history[-1]
@@ -344,3 +344,242 @@ def test_sparse_path_leaves_no_inflight_pins():
     assert len(out) > 3
     assert sp.runner.history[-1]["query_type"] == "fallback"
     assert sp.runner._hbm_ledger.inflight_bytes == 0
+
+
+# ------------------------------------ stage-graph scheduler (ISSUE 16)
+
+
+FOREGROUND = ("plan", "enqueue", "transfer", "finalize", "assemble")
+
+
+@pytest.mark.parametrize("site", [f"stage-{s}" for s in FOREGROUND])
+def test_fault_at_each_stage_boundary_still_answers(site):
+    """Every stage boundary carries a fault-injection site; a fault at
+    any of them must never surface — the engine retries or falls back
+    and the answer stays frame-identical, then heals."""
+    from tpu_olap.resilience import FaultInjector
+    eng = Engine(EngineConfig(breaker_failure_threshold=100))
+    _register(eng)
+    want = _reference()
+    eng.sql(SQL)  # warm before arming
+    inj = FaultInjector(stages={site}, fail_calls=(1,))
+    eng.config.fault_injector = inj
+    try:
+        pd.testing.assert_frame_equal(eng.sql(SQL), want)
+    finally:
+        eng.config.fault_injector = None
+    assert inj.faults == 1, f"{site} never fired"
+    # healed: next query rides the device path, no stranded slots
+    pd.testing.assert_frame_equal(eng.sql(SQL), want)
+    snap = eng.runner.stages.snapshot()["pools"]
+    assert all(p["active"] == 0 for p in snap.values()), snap
+
+
+def test_breaker_trips_between_enqueue_and_transfer_stage():
+    """A fault at the transfer *stage boundary* (after enqueue released
+    the lock, before the host copy) is a terminal device failure just
+    like a mid-transfer loss: two of them open the breaker and the
+    engine serves degraded."""
+
+    class FailBoundary:
+        stages = {"stage-transfer"}
+
+        def __call__(self, stage, attempt):
+            raise RuntimeError("injected loss at the transfer boundary")
+
+    eng = Engine(EngineConfig(dispatch_retries=0,
+                              breaker_failure_threshold=2,
+                              breaker_open_cooldown_s=30.0,
+                              fault_injector=FailBoundary()))
+    _register(eng)
+    try:
+        want = _reference()
+        for _ in range(2):
+            pd.testing.assert_frame_equal(eng.sql(SQL), want)
+        assert eng.runner.breaker.state == "open"
+        got = eng.sql(SQL)
+        assert eng.runner.history[-1]["path"] == "fallback_breaker"
+        pd.testing.assert_frame_equal(got, want)
+    finally:
+        eng.runner.breaker.close()
+        eng.config.fault_injector = None
+
+
+def test_deadline_expiry_at_transfer_stage_boundary():
+    """_StallTransfer again, but stalling at the stage-transfer site:
+    the stage section sits inside the deadline watchdog, so a stall at
+    the boundary trips the deadline exactly like a mid-copy hang."""
+    inj = _StallTransfer(stall_s=2.0)
+    inj.stages = {"stage-transfer"}
+    eng = Engine(EngineConfig(dispatch_retries=0, fault_injector=inj))
+    _register(eng)
+    want = _reference()
+    eng.sql(SQL)  # warm compile outside the deadline regime
+    eng.config.query_deadline_s = 0.4
+    inj.armed = True
+    got = eng.sql(SQL)
+    assert inj.fired == 1
+    assert any(h.get("deadline_exceeded") for h in eng.runner.history)
+    pd.testing.assert_frame_equal(got, want)
+    eng.config.query_deadline_s = 30.0
+    pd.testing.assert_frame_equal(eng.sql(SQL), want)
+    assert not eng.runner._wedged
+    time.sleep(1.8)  # let the abandoned transfer thread drain
+
+
+def test_stage_pool_bounds_and_reclaims_stranded_slots():
+    """StagePool unit contract: slots bound concurrency, a budgeted
+    waiter raises the deadline error when none frees, and
+    reclaim_stranded frees abandoned slots (the late release no-ops)."""
+    from tpu_olap.executor.runner import QueryDeadlineExceeded
+    from tpu_olap.executor.stages import StageScheduler
+    sched = StageScheduler(EngineConfig())
+    pool = sched.pools["enqueue"]  # width 1: one chip program queue
+    assert pool.max_workers == 1
+    entered, release = threading.Event(), threading.Event()
+
+    def strand():
+        with pool.section():
+            entered.set()
+            release.wait(timeout=30)
+
+    t = threading.Thread(target=strand, daemon=True)
+    t.start()
+    assert entered.wait(5)
+    with pytest.raises(QueryDeadlineExceeded):
+        with pool.section(budget_s=0.05):
+            pass  # pragma: no cover
+    time.sleep(0.25)
+    assert sched.reclaim_stranded(0.2) >= 1
+    with pool.section(budget_s=5.0) as waited_ms:
+        assert waited_ms >= 0.0  # slot reclaimed, section admitted
+    release.set()
+    t.join(timeout=10)
+    # the stranded holder's own release was a no-op: no double-free
+    tot = pool.totals()
+    assert tot["active"] == 0 and tot["stranded"] >= 1
+    sched.stop()
+
+
+def test_stage_section_is_reentrant_per_thread():
+    """A thread already inside a stage section re-enters for free —
+    chained work (checkpoint after compact) must not deadlock on its
+    own slot or double-count occupancy."""
+    from tpu_olap.executor.stages import StageScheduler
+    sched = StageScheduler(EngineConfig())
+    pool = sched.pools["enqueue"]  # width 1
+    with pool.section():
+        with pool.section():  # would deadlock if not re-entrant
+            assert pool.totals()["active"] == 1
+    assert pool.totals()["active"] == 0
+    sched.stop()
+
+
+def test_scheduler_background_graph_runs_wakes_and_rearms():
+    """register_periodic drives a background graph off the one ticker:
+    it runs on interval, wake() runs it now, cancel() stops it — and
+    after stop() the scheduler re-arms so a later registration still
+    runs (the engine stays usable after close)."""
+    from tpu_olap.executor.stages import StageScheduler
+    sched = StageScheduler(EngineConfig())
+    runs = []
+    h = sched.register_periodic("probe", lambda: 30.0,
+                                lambda: runs.append(1))
+    h.wake()
+    deadline = time.monotonic() + 10
+    while not runs and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert runs and h.runs >= 1
+    sched.stop()
+    assert h.cancelled
+    # re-arm: a fresh registration after stop still ticks
+    runs2 = []
+    h2 = sched.register_periodic("probe2", lambda: 0.05,
+                                 lambda: runs2.append(1))
+    assert not h2.cancelled
+    deadline = time.monotonic() + 10
+    while not runs2 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert runs2
+    sched.stop()
+
+
+def test_background_graph_fault_is_recorded_and_retried():
+    """A fault inside a background graph body (stage-background site)
+    is caught by the launcher — errors are counted on the handle and
+    the next wake retries the body successfully."""
+    from tpu_olap.resilience import FaultInjector
+    from tpu_olap.resilience.faults import maybe_inject
+    cfg = EngineConfig(
+        fault_injector=FaultInjector(stages={"stage-background"},
+                                     fail_calls=(1,)))
+    from tpu_olap.executor.stages import StageScheduler
+    sched = StageScheduler(cfg, inject=lambda s: maybe_inject(cfg, s))
+    runs = []
+    h = sched.register_periodic("flaky", lambda: 30.0,
+                                lambda: runs.append(1))
+    h.wake()  # first run: injected fault before the body
+    deadline = time.monotonic() + 10
+    while h.errors < 1 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert h.errors == 1 and not runs
+    assert "injected fault" in (h.last_error or "")
+    h.wake()  # retry succeeds
+    deadline = time.monotonic() + 10
+    while not runs and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert runs
+    sched.stop()
+
+
+def test_mixed_class_16_thread_sha_parity_at_depth4():
+    """16 threads in the bench's 6/6/2/2 grouped/ungrouped/fallback/
+    statement mix at the new default depth 4: every response hashes
+    identical to its single-threaded reference, every foreground stage
+    saw traffic, and no stage slot leaks."""
+    import hashlib
+    eng = Engine(EngineConfig(pipeline_depth=4))
+    _register(eng)
+    qs = {
+        "grouped": SQL,
+        "ungrouped": "SELECT sum(v) AS s, count(*) AS n FROM t "
+                     "WHERE v < 50",
+        "fallback": "SELECT g, v, row_number() OVER "
+                    "(PARTITION BY g ORDER BY v DESC, ts) AS r "
+                    "FROM t WHERE v > 90",
+        "statement": "EXPLAIN DRUID REWRITE SELECT g, sum(v) AS s "
+                     "FROM t GROUP BY g",
+    }
+
+    def sha(df):
+        return hashlib.sha256(
+            df.to_csv(index=False).encode()).hexdigest()
+
+    ref = {k: sha(eng.sql(q)) for k, q in qs.items()
+           if k != "statement"}
+    errs = []
+    mix = ["grouped"] * 6 + ["ungrouped"] * 6 + \
+          ["fallback"] * 2 + ["statement"] * 2
+
+    def worker(label):
+        try:
+            for _ in range(3):
+                out = eng.sql(qs[label])
+                if label != "statement":
+                    got = sha(out)
+                    assert got == ref[label], (label, got)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errs.append((label, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(lb,))
+               for lb in mix]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not errs, errs
+    snap = eng.runner.stages.snapshot()["pools"]
+    for s in FOREGROUND:
+        assert snap[s]["submitted"] > 0, (s, snap[s])
+        assert snap[s]["active"] == 0, (s, snap[s])
+    assert snap["enqueue"]["max_workers"] == 1

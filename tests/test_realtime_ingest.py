@@ -329,14 +329,15 @@ def test_background_compactor_and_close_joins_threads():
         time.sleep(0.02)
     assert eng.catalog.get("t").segments.delta_rows == 0
     _assert_parity(eng, _reference(rows), "bg-compact")
-    # deterministic shutdown: compactor joined, maintainer joined
-    compactor = eng.ingest._compactor
+    # deterministic shutdown: the compactor/maintainer background
+    # graphs are cancelled (and any in-progress pass joined)
+    compactor = eng.ingest._compact_handle
     assert compactor is not None
     eng.close()
-    assert eng.ingest._compactor is None
-    assert not compactor.is_alive()
-    m = eng.cubes._maintainer
-    assert m is None or not m.is_alive()
+    assert eng.ingest._compact_handle is None
+    assert compactor.cancelled and not compactor.running
+    m = eng.cubes._handle
+    assert m is None or (m.cancelled and not m.running)
     # the engine stays usable after close
     assert int(eng.sql("SELECT count(*) AS n FROM t")["n"][0]) == 2006
 
@@ -466,9 +467,9 @@ def test_http_ingest_endpoints(tmp_path):
         assert snap["tables"]["t"]["wal"]["bytes"] > 0
     finally:
         srv.stop()
-    # Server.stop() called Engine.close(): background threads joined
-    assert eng.ingest._compactor is None \
-        or not eng.ingest._compactor.is_alive()
+    # Server.stop() called Engine.close(): background graphs cancelled
+    h = eng.ingest._compact_handle
+    assert h is None or (h.cancelled and not h.running)
 
 
 # ------------------------------------------------------ chaos suite

@@ -59,12 +59,21 @@ def load_artifact(path: str) -> dict:
     if "throughput_qps" in doc and isinstance(doc.get("per_class"),
                                               dict):
         # concurrency artifact (tools/bench_concurrency.py): gate on
-        # throughput + per-class p99 instead of per-query p50
+        # throughput + per-class p99 + per-stage queue wait (when both
+        # artifacts carry the stage-scheduler occupancy block)
+        stages = doc.get("stages")
         return {"kind": "concurrency",
                 "qps": float(doc["throughput_qps"]),
                 "p99": {str(c): float(v["p99_ms"])
                         for c, v in doc["per_class"].items()
-                        if isinstance(v, dict) and "p99_ms" in v}}
+                        if isinstance(v, dict) and "p99_ms" in v},
+                "stages": {str(s): {
+                    "wait_mean": float(v["queue_wait_ms_mean"]),
+                    "busy_frac": float(v["busy_frac"])}
+                    for s, v in stages.items()
+                    if isinstance(v, dict)
+                    and "queue_wait_ms_mean" in v}
+                if isinstance(stages, dict) else None}
     if doc.get("mode") == "multichip" and \
             isinstance(doc.get("per_query"), dict):
         # sharded-serving artifact (bench.py --mesh N): gate mesh p50
@@ -120,8 +129,9 @@ def compare(base: dict, new: dict, threshold: float):
 
 def compare_concurrency(base: dict, new: dict, threshold: float) -> int:
     """Throughput-regression gate for BENCH_CONCURRENCY.json artifacts:
-    exit 1 when throughput_qps dropped more than the threshold, or any
-    class's p99 grew beyond it (with the absolute jitter floor)."""
+    exit 1 when throughput_qps dropped more than the threshold, any
+    class's p99 grew beyond it, or any stage pool's mean queue wait
+    grew beyond it (each with the absolute jitter floor)."""
     regressions = []
     bq, nq = base["qps"], new["qps"]
     dq = (nq - bq) / bq if bq > 0 else 0.0
@@ -141,13 +151,30 @@ def compare_concurrency(base: dict, new: dict, threshold: float) -> int:
             regressions.append(f"{cls}.p99")
         print(f"{cls + '.p99_ms':<16}  {b:>10.1f}  {n:>10.1f}  "
               f"{d:>+7.1%}  {'REGRESSED(p99)' if reg else 'ok'}")
+    # per-stage queue-wait gate: a stage pool the load newly convoys
+    # on is a regression even while total qps holds (the burst just
+    # moved). Baselines banked before the stage scheduler existed have
+    # no block — skipped, never gated. busy_frac is informational.
+    if base.get("stages") and new.get("stages"):
+        for s in sorted(set(base["stages"]) & set(new["stages"])):
+            b = base["stages"][s]["wait_mean"]
+            n = new["stages"][s]["wait_mean"]
+            d = (n - b) / b if b > 0 else 0.0
+            reg = d > threshold and (n - b) > ABS_FLOOR_MS
+            if reg:
+                regressions.append(f"{s}.queue_wait")
+            print(f"{s + '.wait_ms':<16}  {b:>10.3f}  {n:>10.3f}  "
+                  f"{d:>+7.1%}  "
+                  f"{'REGRESSED(queue_wait)' if reg else 'ok'}"
+                  f"  [busy {base['stages'][s]['busy_frac']:.3f}"
+                  f" -> {new['stages'][s]['busy_frac']:.3f}]")
     if regressions:
         print(f"\nbench_compare: {len(regressions)} concurrency "
               f"metric(s) regressed past {threshold:.0%}: "
               f"{', '.join(regressions)}", file=sys.stderr)
         return 1
-    print(f"\nbench_compare: ok (throughput + per-class p99 within "
-          f"{threshold:.0%})")
+    print(f"\nbench_compare: ok (throughput + per-class p99 + stage "
+          f"queue waits within {threshold:.0%})")
     return 0
 
 

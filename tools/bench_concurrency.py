@@ -11,8 +11,11 @@ the whole query, the pre-pipeline behavior) and once pipelined
 (`--pipeline-depth N`, default 2: the lock held only for stage-1
 enqueue; transfer/finalize/assembly overlap other queries' device
 work). Each run also banks the dispatch-lock-wait split (p50/p99 from
-the `dispatch_lock_wait_ms` histogram) and the device-occupancy
-fraction, so the artifact shows WHERE the throughput came from.
+the `dispatch_lock_wait_ms` histogram), the device-occupancy fraction,
+and per-stage occupancy + queue-wait columns from the stage scheduler
+(executor/stages.py — runs/busy_frac/queue_wait per plan/enqueue/
+transfer/finalize/assemble pool), so the artifact shows WHERE the
+throughput came from and which stage pool the load convoys on.
 
 Parity: deterministic classes (grouped / ungrouped / fallback) compare
 every response against a reference computed before the load starts;
@@ -186,6 +189,23 @@ def run_load(df, pipeline_depth: int, n_clients: int, seconds: float,
     # >1.0 means overlapped execution (the pipeline's point)
     exec_ms = sum(m.get("execute_ms") or 0.0 for m in eng.history
                   if m.get("execute_ms"))
+    # per-stage occupancy + queue wait from the stage scheduler
+    # (executor/stages.py): busy_frac > the serialized arm's means the
+    # stage genuinely overlapped other queries' work; queue_wait shows
+    # which stage pool the load convoys on
+    stage_stats = {}
+    for name, pool in eng.runner.stages.snapshot()["pools"].items():
+        if not pool["submitted"]:
+            continue
+        stage_stats[name] = {
+            "runs": pool["submitted"],
+            "busy_ms": round(pool["busy_ms"], 1),
+            "busy_frac": round(pool["busy_ms"] / (wall * 1000), 3),
+            "queue_wait_ms_total": round(pool["wait_ms"], 1),
+            "queue_wait_ms_mean": round(
+                pool["wait_ms"] / pool["submitted"], 3),
+            "stranded": pool["stranded"],
+        }
     srv.stop()
 
     per_class = {}
@@ -224,6 +244,7 @@ def run_load(df, pipeline_depth: int, n_clients: int, seconds: float,
         else round(lock_p99, 3),
         "device_busy_frac": round(exec_ms / (wall * 1000), 3),
         "device_dispatches": len(eng.history),
+        "stages": stage_stats,
     }
 
 
@@ -233,10 +254,9 @@ def main(argv=None):
                     "serialized A/B over a live QueryServer.")
     p.add_argument(
         "--pipeline-depth", type=int, default=4, metavar="N",
-        help="in-flight pipeline depth for the pipelined arm "
-             "(default 4 — the measured sweet spot for the A/B on a "
-             "multi-core CPU host; the engine's own default is 2); "
-             "0 runs ONLY the serialized baseline")
+        help="in-flight stage-graph depth for the pipelined arm "
+             "(default 4, matching the engine default); 0 runs ONLY "
+             "the serialized baseline")
     p.add_argument(
         "--smoke", action="store_true",
         help="CI smoke: one short pipelined parity run (no artifact "
@@ -257,12 +277,19 @@ def main(argv=None):
     if args.smoke:
         depth = max(1, args.pipeline_depth)
         stats = run_load(df, depth, n_clients, seconds, think_s)
+        # every foreground stage class must have seen traffic — a
+        # silent stage (never entered) means the graph wiring broke
+        missing_stages = [s for s in ("plan", "enqueue", "transfer",
+                                      "finalize", "assemble")
+                          if s not in stats["stages"]]
         bad = bool(stats["starved_classes"] or stats["errors"]
-                   or stats["parity_failures"])
+                   or stats["parity_failures"] or missing_stages)
         print(json.dumps({"ok": not bad, "qps": stats["throughput_qps"],
                           "starved": stats["starved_classes"],
                           "errors": stats["errors"],
-                          "parity_failures": stats["parity_failures"]}))
+                          "parity_failures": stats["parity_failures"],
+                          "missing_stages": missing_stages,
+                          "stages": sorted(stats["stages"])}))
         return 1 if bad else 0
 
     serialized = run_load(df, 0, n_clients, seconds, think_s)
@@ -284,6 +311,7 @@ def main(argv=None):
         "starved_classes": head["starved_classes"],
         "parity_failures": head["parity_failures"],
         "pipeline_depth": head["pipeline_depth"],
+        "stages": head["stages"],
         "serialized": serialized,
         "pipelined": pipelined,
         "speedup_vs_serialized": None if pipelined is None else round(

@@ -284,13 +284,15 @@ class Engine:
         return self.ingest.checkpoint_now(table)
 
     def close(self):
-        """Deterministically stop and JOIN every background thread the
-        engine owns — the compactor and WAL flushers (ingest.stop) and
-        the cube maintainer — and flush the event sink. The engine
-        stays queryable afterwards; appends reopen WALs lazily and
-        restart the compactor on demand. Server.stop() calls this."""
+        """Deterministically cancel every background stage graph the
+        engine owns — the compactor and WAL flushers (ingest.stop),
+        the cube maintainer, and the stage scheduler's ticker — and
+        flush the event sink. The engine stays queryable afterwards;
+        appends reopen WALs lazily and re-register the compactor/flush
+        graphs on demand. Server.stop() calls this."""
         self.ingest.stop()
         self.cubes.stop(join=True)
+        self.runner.stages.stop()
         self.runner.events.flush(2.0)
 
     def register_lookup(self, name: str, mapping: dict):

@@ -337,6 +337,7 @@ class QueryServer:
                     "admission": eng.runner.admission.snapshot(),
                 },
                 "slo": eng.runner.slo.snapshot(),
+                "stages": eng.runner.stages.snapshot(),
                 "device_bytes": eng.runner.device_bytes_by_table(),
             }
         if path.startswith("/status/metadata/"):

@@ -150,8 +150,10 @@ class CubeRegistry:
         self.engine = engine
         self._cubes: dict[str, CubeEntry] = {}
         self._lock = threading.RLock()
-        self._wake = threading.Event()
-        self._maintainer: threading.Thread | None = None
+        # the refresh maintainer is a scheduler-managed background
+        # stage graph (executor.stages.register_periodic), not a
+        # bespoke daemon thread — this is its PeriodicHandle
+        self._handle = None
         self._stopped = False
         m = engine.metrics
         self._m_req = m.counter(
@@ -276,7 +278,9 @@ class CubeRegistry:
                         for e in self._cubes.values())
         if stale:
             self._ensure_maintainer()
-            self._wake.set()
+            h = self._handle
+            if h is not None:
+                h.wake()
 
     # -------------------------------------------------------- maintenance
 
@@ -322,49 +326,43 @@ class CubeRegistry:
         return results
 
     def _ensure_maintainer(self):
+        """Register the `cube-maintain` background graph on the stage
+        scheduler (lazily — honors a cube_auto_refresh flag flipped on
+        at runtime; re-registers after Engine.close cancelled it)."""
         if not self.engine.config.cube_auto_refresh or self._stopped:
             return
         with self._lock:
-            if self._maintainer is not None and \
-                    self._maintainer.is_alive():
+            h = self._handle
+            if h is not None and not h.cancelled:
                 return
-            t = threading.Thread(target=self._maintain_loop,
-                                 name="cube-maintainer", daemon=True)
-            self._maintainer = t
-            t.start()
+            self._handle = self.engine.runner.stages.register_periodic(
+                "cube-maintain",
+                lambda: self.engine.config.cube_refresh_interval_s,
+                self._maintain_pass)
 
     def stop(self, join: bool = False):
-        """Stop the maintainer; `join=True` (Engine.close) blocks until
-        the thread exits so shutdown is deterministic instead of
-        leaving an unjoined daemon behind."""
+        """Cancel the maintainer graph; `join=True` (Engine.close)
+        blocks until an in-progress pass exits so shutdown is
+        deterministic instead of leaving work behind."""
         self._stopped = True
-        self._wake.set()
-        if join:
-            with self._lock:
-                t = self._maintainer
-            if t is not None and t.is_alive():
-                t.join(timeout=10.0)
+        h = self._handle
+        if h is not None:
+            h.cancel(join_timeout=10.0 if join else None)
 
-    def _maintain_loop(self):
-        """Background refresh: wait out the interval (or an ingest
-        wake), rebuild stale cubes one at a time. Builds go through
-        compute_partials, i.e. the same admission slot + breaker check
-        as foreground queries — an open breaker or a shed just means
-        'retry next tick', never a crashed thread."""
-        while not self._stopped:
-            self._wake.wait(
-                max(0.05, float(self.engine.config
-                                .cube_refresh_interval_s)))
-            self._wake.clear()
+    def _maintain_pass(self):
+        """One background-graph tick: rebuild stale cubes one at a
+        time. Runs on the scheduler's background stage pool every
+        cube_refresh_interval_s (or on an ingest wake). Builds go
+        through compute_partials, i.e. the same admission slot +
+        breaker check as foreground queries — an open breaker or a
+        shed just means 'retry next tick', never a dead graph."""
+        for e in self.stale_cubes():
             if self._stopped:
                 return
-            for e in self.stale_cubes():
-                if self._stopped:
-                    return
-                try:
-                    self._build(e, refresh=True)
-                except Exception:  # noqa: BLE001 — retried next tick
-                    pass
+            try:
+                self._build(e, refresh=True)
+            except Exception:  # noqa: BLE001 — retried next tick
+                pass
 
     # --------------------------------------------------------------- build
 

@@ -298,18 +298,22 @@ class EngineConfig:
     # reprobe, ingest, batch-leg (resilience.faults.maybe_inject).
     fault_injector: object = None
 
-    # --- pipelined execution (docs/PERF_MODEL.md "execution pipeline") ---
-    # Two-stage query pipeline: stage 1 (enqueue) holds dispatch_lock
-    # only while the device program is fired — JAX dispatch is async, so
-    # the lock releases as soon as the device has the work and the
-    # result buffers are pinned in the HbmLedger; stage 2 (complete)
-    # runs the device->host transfer (ONE jax.device_get of the whole
-    # output tree), finalize, post-aggs, and assembly on the caller's
-    # thread, lock-free. pipeline_depth bounds how many dispatches may
-    # sit between enqueue and complete at once (queued device work +
-    # pinned result buffers stay within the HBM budget); 0 restores the
-    # serialized behavior (dispatch_lock held across the whole query).
-    pipeline_depth: int = 2
+    # --- stage-graph execution (docs/EXECUTION.md; docs/PERF_MODEL.md
+    # "execution pipeline") ---
+    # Every query runs as an explicit stage graph — plan -> enqueue ->
+    # transfer -> finalize -> assemble — driven by executor/stages.py.
+    # Each stage class has its own bounded pool (enqueue stays width 1:
+    # the chip has one program queue; the others scale with this knob),
+    # so the old two-phase split generalizes: enqueue holds
+    # dispatch_lock only while the device program is fired, and the
+    # transfer/finalize/assemble stages of different queries overlap.
+    # pipeline_depth is GRAPH ADMISSION: it bounds how many per-query
+    # stage graphs are in flight engine-wide (queued device work +
+    # pinned result buffers stay within the HBM budget) while the
+    # per-stage queues absorb bursts inside admitted graphs; 0 restores
+    # the serialized behavior (dispatch_lock held across the whole
+    # query, no graph admission).
+    pipeline_depth: int = 4
 
     # --- resilience layer (tpu_olap.resilience; docs/RESILIENCE.md) ---
     # admission control: a bounded device-dispatch queue in front of

@@ -321,6 +321,15 @@ class QueryRunner:
             latency_window=self.config.workload_latency_window,
             enabled=self.config.workload_profile_enabled, metrics=m)
         self._attempt_local = threading.local()  # host-transfer inject
+        # stage-graph scheduler (executor.stages; docs/EXECUTION.md):
+        # per-stage bounded pools + graph admission for the query path,
+        # and the periodic-graph ticker the background subsystems (cube
+        # maintainer, compactor, WAL flusher) register with
+        from tpu_olap.executor.stages import StageScheduler
+        self.stages = StageScheduler(self.config, metrics=m,
+                                     admission=self.admission,
+                                     inject=self._inject,
+                                     events=self.events)
 
     def _inject(self, stage: str):
         """Generalized fault-injection hook (resilience.faults): fires
@@ -360,34 +369,37 @@ class QueryRunner:
 
     @contextmanager
     def _enqueue_lock(self, metrics: dict | None = None):
-        """Stage-1 critical section. Pipelined mode: acquire
-        dispatch_lock (bounded by the deadline budget so an abandoned
-        watchdog thread blocked here eventually exits instead of
-        leaking), time the wait into dispatch_lock_wait_ms, and stamp
-        the record. Serialized mode: the caller already holds the lock
-        across the whole query (QueryRunner.execute) — possibly on the
-        watchdog's parent thread — so this is a no-op."""
-        if not self._pipelined:
-            yield
-            return
-        deadline = self.config.query_deadline_s
-        t0 = time.perf_counter()
-        ok = self.dispatch_lock.acquire(timeout=deadline) \
-            if deadline is not None else self.dispatch_lock.acquire()
-        waited = (time.perf_counter() - t0) * 1000
-        self._m_lock_wait.observe(waited)
-        if metrics is not None:
-            metrics["pipelined"] = True
-            metrics["lock_wait_ms"] = round(
-                metrics.get("lock_wait_ms", 0.0) + waited, 3)
-        if not ok:
-            raise QueryDeadlineExceeded(
-                f"dispatch lock unavailable within the {deadline}s "
-                "deadline (a dispatch is wedged holding it)") from None
-        try:
-            yield
-        finally:
-            self.dispatch_lock.release()
+        """The enqueue stage's critical section (width-1 stage pool +
+        dispatch_lock: the chip has one program queue). Pipelined mode:
+        acquire dispatch_lock (bounded by the deadline budget so an
+        abandoned watchdog thread blocked here eventually exits instead
+        of leaking), time the wait into dispatch_lock_wait_ms, and
+        stamp the record. Serialized mode: the caller already holds the
+        lock across the whole query (QueryRunner.execute) — possibly on
+        the watchdog's parent thread — so only the stage accounting
+        runs."""
+        with self.stages.stage("enqueue", metrics):
+            if not self._pipelined:
+                yield
+                return
+            deadline = self.config.query_deadline_s
+            t0 = time.perf_counter()
+            ok = self.dispatch_lock.acquire(timeout=deadline) \
+                if deadline is not None else self.dispatch_lock.acquire()
+            waited = (time.perf_counter() - t0) * 1000
+            self._m_lock_wait.observe(waited)
+            if metrics is not None:
+                metrics["pipelined"] = True
+                metrics["lock_wait_ms"] = round(
+                    metrics.get("lock_wait_ms", 0.0) + waited, 3)
+            if not ok:
+                raise QueryDeadlineExceeded(
+                    f"dispatch lock unavailable within the {deadline}s "
+                    "deadline (a dispatch is wedged holding it)") from None
+            try:
+                yield
+            finally:
+                self.dispatch_lock.release()
 
     @contextmanager
     def _timed_dispatch_lock(self):
@@ -430,13 +442,14 @@ class QueryRunner:
             pin = self._pin_inflight(out)
         self._note_transfer(1)
         try:
-            self._inject("host-transfer")
-            if self.config.platform == "cpu":
-                host = {k: np.asarray(v) for k, v in out.items()} \
-                    if isinstance(out, dict) else np.asarray(out)
-            else:
-                import jax
-                host = jax.device_get(out)
+            with self.stages.stage("transfer", metrics):
+                self._inject("host-transfer")
+                if self.config.platform == "cpu":
+                    host = {k: np.asarray(v) for k, v in out.items()} \
+                        if isinstance(out, dict) else np.asarray(out)
+                else:
+                    import jax
+                    host = jax.device_get(out)
         finally:
             self._note_transfer(-1)
             if pin is not None:
@@ -446,6 +459,45 @@ class QueryRunner:
                 metrics.get("transfer_ms", 0.0)
                 + (time.perf_counter() - t0) * 1000, 3)
         return host
+
+    def _fetch_trees(self, outs: list, metrics: dict | None = None,
+                     pin=None):
+        """Per-chip transfer nodes (docs/EXECUTION.md): each chip's
+        output tree fetches on its own transfer-stage slot
+        (stages.map_stage), so D transfers overlap one another AND the
+        next query's enqueue instead of serializing behind one
+        device_get. The numpy platform (or a single tree) degrades to
+        the one-call fetch — no thread hop for nothing."""
+        if self.config.platform == "cpu" or len(outs) <= 1:
+            return self._fetch_tree(outs, metrics, pin)
+        t0 = time.perf_counter()
+        try:
+            host = self.stages.map_stage(
+                "transfer",
+                [(lambda o=o: self._fetch_chip(o, metrics))
+                 for o in outs])
+        finally:
+            if pin is not None:
+                self._hbm_ledger.unpin_inflight(pin)
+        if metrics is not None:
+            metrics["transfer_ms"] = round(
+                metrics.get("transfer_ms", 0.0)
+                + (time.perf_counter() - t0) * 1000, 3)
+            metrics["transfer_fanout"] = len(outs)
+        return host
+
+    def _fetch_chip(self, out, metrics: dict | None = None):
+        """One chip's transfer node: its own transfer-stage slot + the
+        host-transfer fault site. No pin bookkeeping — the caller's
+        fan-out pin covers the whole set until every chip lands."""
+        self._note_transfer(1)
+        try:
+            with self.stages.stage("transfer", metrics):
+                self._inject("host-transfer")
+                import jax
+                return jax.device_get(out)
+        finally:
+            self._note_transfer(-1)
 
     def _metric_path(self, m: dict) -> str:
         """Dashboard path label: which execution flavor served this
@@ -1064,8 +1116,11 @@ class QueryRunner:
             self.dispatch_lock.release()
         # reclaim in-flight pipeline slots held by abandoned dispatch
         # threads: the device is verified healthy and its state purged,
-        # so the stranded holders' slots must not zero device capacity
+        # so the stranded holders' slots must not zero device capacity.
+        # Stage-pool slots stranded the same way (a worker abandoned
+        # mid-transfer still occupies its stage) are reclaimed too.
         self.admission.reset_pipeline()
+        self.stages.reclaim_stranded()
         self.record({"device_probe_recovered": True})
         return True
 
@@ -1219,6 +1274,10 @@ class QueryRunner:
         per-query budget. Keyed on the full query JSON plus the
         lowering-relevant config knobs; a table identity check (not just
         the name) invalidates on re-registration."""
+        with self.stages.stage("plan", self._last_metrics):
+            return self._lower_cached_inner(query, table)
+
+    def _lower_cached_inner(self, query, table):
         import json as _json
 
         c = self.config
@@ -1254,6 +1313,14 @@ class QueryRunner:
         return plan
 
     def _execute_inner(self, query, table) -> QueryResult:
+        # one in-flight stage graph per query: pipeline_depth counts
+        # graphs engine-wide (stages.StageScheduler.graph wraps the
+        # admission controller's pipeline slot — re-entrant, so the
+        # per-dispatch _pipeline_slot holds inside become no-ops here)
+        with self.stages.graph(self.config.query_deadline_s):
+            return self._execute_graph(query, table)
+
+    def _execute_graph(self, query, table) -> QueryResult:
         if isinstance(query, TimeBoundaryQuerySpec):
             res = self._run_time_boundary(query, table)
         elif isinstance(query, SegmentMetadataQuerySpec):
@@ -2024,7 +2091,7 @@ class QueryRunner:
                             f"{local_max} per-chip present groups "
                             f"exceed sparse budget {local_limit}")
                     cap = min(local_limit, _next_pow2(local_max))
-                parts = self._fetch_tree(outs, metrics, pin)
+                parts = self._fetch_trees(outs, metrics, pin)
                 pin = None  # consumed (fetch unpins)
             finally:
                 if pin is not None:
@@ -2073,11 +2140,12 @@ class QueryRunner:
             out, count = self._dispatch(
                 lambda: self._run_sparse(plan, metrics), metrics, table.name)
             t0 = time.perf_counter()
-            with _span("finalize"):
-                arrays = finalize_aggs(out, plan.agg_plans, specs,
-                                       keep_raw)
-            with _span("post-agg"):
-                eval_post_aggs(arrays, query.post_aggregations)
+            with self.stages.stage("finalize", metrics):
+                with _span("finalize"):
+                    arrays = finalize_aggs(out, plan.agg_plans, specs,
+                                           keep_raw)
+                with _span("post-agg"):
+                    eval_post_aggs(arrays, query.post_aggregations)
             names = self._out_names(query)
             # present groups by sentinel mask: compact tables fill the
             # tail with SENTINEL; exchange slot tables interleave empties
@@ -2085,7 +2153,8 @@ class QueryRunner:
             pm = keys != SENTINEL
             present = keys[pm].astype(np.int64)
             sub = {n: np.asarray(arrays[n])[pm] for n in names}
-            with _span("assemble"):
+            with self.stages.stage("assemble", metrics), \
+                    _span("assemble"):
                 res = self._emit_groupby(query, plan, present, sub)
             res.metrics = metrics
             metrics["assemble_ms"] = (time.perf_counter() - t0) * 1000
@@ -2096,9 +2165,11 @@ class QueryRunner:
                                              keep_raw, table)
             if arrays is not None:
                 t0 = time.perf_counter()
-                with _span("post-agg"):
+                with self.stages.stage("finalize", metrics), \
+                        _span("post-agg"):
                     eval_post_aggs(arrays, query.post_aggregations)
-                with _span("assemble"):
+                with self.stages.stage("assemble", metrics), \
+                        _span("assemble"):
                     res = self._assemble_agg(query, plan, arrays)
                 res.metrics = metrics
                 metrics["assemble_ms"] = (time.perf_counter() - t0) * 1000
@@ -2119,7 +2190,8 @@ class QueryRunner:
                         getattr(specs.get(p.name), "round", True):
                     compact[p.name] = np.round(compact[p.name])
             t0 = time.perf_counter()
-            with _span("finalize"):
+            with self.stages.stage("finalize", metrics), \
+                    _span("finalize"):
                 arrays = densify(idx, compact, layout, plan.agg_plans)
         else:
             if use_packed:
@@ -2128,12 +2200,13 @@ class QueryRunner:
                 lambda: self._run_partials(plan, metrics), metrics,
                 table.name)
             t0 = time.perf_counter()
-            with _span("finalize"):
+            with self.stages.stage("finalize", metrics), \
+                    _span("finalize"):
                 arrays = finalize_aggs(partials, plan.agg_plans, specs,
                                        keep_raw)
-        with _span("post-agg"):
+        with self.stages.stage("finalize", metrics), _span("post-agg"):
             eval_post_aggs(arrays, query.post_aggregations)
-        with _span("assemble"):
+        with self.stages.stage("assemble", metrics), _span("assemble"):
             res = self._assemble_agg(query, plan, arrays)
         res.metrics = metrics
         metrics["assemble_ms"] = (time.perf_counter() - t0) * 1000
@@ -2540,7 +2613,7 @@ class QueryRunner:
             offset, limit = query.paging_offset, query.page_size
             descending = query.descending
 
-        with _span("assemble"):
+        with self.stages.stage("assemble", metrics), _span("assemble"):
             events = self._gather_rows(table, mask, cols, offset, limit,
                                        descending)
         metrics["assemble_ms"] = (time.perf_counter() - t0) * 1000

@@ -182,8 +182,14 @@ def group_reduce(key, mask, env, plans, num_groups, consts):
         else:
             m_key = key
         if p.kind == "count":
-            out[p.name] = _seg_sum(m.astype(p.acc_dtype), m_key, num_groups,
-                                   xp)
+            if p.filter_fn is None:
+                # unfiltered COUNT(*) is the _rows scatter, already
+                # computed — a [K] cast instead of a second [N]->[K]
+                # segment reduction (scatters dominate grouped cost)
+                out[p.name] = out["_rows"].astype(p.acc_dtype)
+            else:
+                out[p.name] = _seg_sum(m.astype(p.acc_dtype), m_key,
+                                       num_groups, xp)
             continue
         if p.kind in ("sum", "min", "max"):
             x = _field_value(env, p.fields[0], xp)
@@ -197,10 +203,16 @@ def group_reduce(key, mask, env, plans, num_groups, consts):
                 v = xp.where(mm, x.astype(p.acc_dtype), ident)
                 out[p.name] = _seg_minmax(v, xp.where(mm, key, 0), num_groups,
                                           p.kind, xp)
-            # per-plan non-null counts for null-correct finalize
-            out[f"_nn_{p.name}"] = _seg_sum(mm.astype(np.int32),
-                                            xp.where(mm, key, 0),
-                                            num_groups, xp)
+            # per-plan non-null counts for null-correct finalize. With no
+            # per-agg filter and no null bitmap, mm IS the row mask, so
+            # the non-null count IS _rows — reuse it instead of paying a
+            # third segment scatter per aggregate.
+            if p.filter_fn is None and nulls is None:
+                out[f"_nn_{p.name}"] = out["_rows"]
+            else:
+                out[f"_nn_{p.name}"] = _seg_sum(mm.astype(np.int32),
+                                                xp.where(mm, key, 0),
+                                                num_groups, xp)
             continue
         if p.kind == "hll":
             if p.by_row or len(p.fields) <= 1:

@@ -121,7 +121,10 @@ def sparse_group_reduce(key, mask, env, plans, cap, consts, xp):
                 ident = _ident(p.acc_dtype, p.kind)
                 carry(f"v:{p.name}",
                       xp.where(mm, x.astype(p.acc_dtype), ident))
-                carry(f"nn:{p.name}", mm)
+                if p.filter_fn is not None or nulls is not None:
+                    # mm == mask otherwise: the non-null count IS _rows,
+                    # so skip both the sort operand and the reduction
+                    carry(f"nn:{p.name}", mm)
         elif p.kind in ("hll", "theta"):
             h, valid = _hash_fields(env, p, m, xp, consts)
             carry(f"h:{p.name}", h)
@@ -153,7 +156,9 @@ def sparse_group_reduce(key, mask, env, plans, cap, consts, xp):
     for p in plans:
         m = smask if p.filter_fn is None else sorted_ops[slots[f"m:{p.name}"]]
         if p.kind == "count":
-            out[p.name] = seg_sum(m.astype(p.acc_dtype))
+            # unfiltered COUNT(*) is the _rows reduction, already done
+            out[p.name] = out["_rows"].astype(p.acc_dtype) \
+                if p.filter_fn is None else seg_sum(m.astype(p.acc_dtype))
             continue
         if p.kind == "sum":
             out[p.name] = seg_sum(sorted_ops[slots[f"v:{p.name}"]])
@@ -161,7 +166,8 @@ def sparse_group_reduce(key, mask, env, plans, cap, consts, xp):
         if p.kind in ("min", "max"):
             out[p.name] = seg_ext(sorted_ops[slots[f"v:{p.name}"]], p.kind)
             out[f"_nn_{p.name}"] = seg_sum(
-                sorted_ops[slots[f"nn:{p.name}"]].astype(np.int32))
+                sorted_ops[slots[f"nn:{p.name}"]].astype(np.int32)) \
+                if f"nn:{p.name}" in slots else out["_rows"]
             continue
         if p.kind == "hll":
             h = sorted_ops[slots[f"h:{p.name}"]]

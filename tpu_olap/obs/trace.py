@@ -300,6 +300,17 @@ class Trace(Span):
         out = super().to_json()
         out["query_id"] = self.query_id
         out["started_at"] = round(self.started_at, 3)
+        # flat per-stage summary of the graph's `stage:<name>` spans
+        # (executor/stages.py), so GET /debug/queries readers get the
+        # stage walk without re-walking the span tree
+        stages = [{"stage": s.name[6:],
+                   "run_ms": round(s.duration_ms, 3),
+                   "wait_ms": s.attrs.get("queue_wait_ms", 0.0)}
+                  for _, s in self.walk()
+                  if s.name.startswith("stage:")
+                  and s.duration_ms is not None]
+        if stages:
+            out["stages"] = stages
         return out
 
 

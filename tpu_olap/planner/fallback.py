@@ -100,18 +100,21 @@ def execute_fallback(stmt, catalog, config) -> pd.DataFrame:
             with _obs_span("fallback-chunked"):
                 return _execute_chunked(stmt, entry, catalog, config)
         df = entry.frame
+        time_col = entry.time_column
         if any(isinstance(c, Lit) and c.value is False
                for c in _split_and(stmt.where)):
             # a statically-false WHERE conjunct (e.g. the decorrelator's
             # empty-input default probe): skip the full copy + time sort
-            df = df.iloc[0:0]
-        df = df.copy()
-        time_col = entry.time_column
-        if time_col is not None and time_col in df.columns:
+            df = df.iloc[0:0].copy()
+        elif time_col is not None and time_col in df.columns:
             # match the accelerated path's deterministic time-sorted row
             # order (segments are time-sorted, so unordered LIMIT picks
-            # the same rows)
-            df = df.sort_values(time_col, kind="stable")
+            # the same rows). Served from the entry's memoized sorted
+            # frame — downstream operators never mutate it in place, so
+            # no per-query defensive copy + O(n log n) re-sort.
+            df = entry.time_sorted_frame()
+        else:
+            df = df.copy()
 
     with _obs_span("fallback-filter") as fsp:
         df = _join_and_filter(stmt, df, catalog, time_col, config)
@@ -2168,8 +2171,24 @@ def _ts(series, time_col):
 def _eval(e, df, time_col):
     """Expression -> Series aligned with df (scalar for Lit)."""
     if isinstance(e, Lit):
-        return pd.Series([e.value] * len(df), index=df.index) \
-            if len(df) else pd.Series([], dtype=object)
+        n = len(df)
+        if not n:
+            return pd.Series([], dtype=object)
+        v = e.value
+        # np.full instead of a python list: a literal operand over a
+        # wide frame must not cost O(n) list construction + inference
+        # (it dominated simple-WHERE fallback profiles). Exact-dtype
+        # parity with the list path: bool stays bool, int64-range ints
+        # stay int64, floats float64, everything else object.
+        if type(v) is bool or type(v) is float:
+            arr = np.full(n, v)
+        elif type(v) is int and -(2 ** 63) <= v < 2 ** 63:
+            arr = np.full(n, v, dtype=np.int64)
+        elif isinstance(v, (list, tuple, set, dict)):
+            return pd.Series([v] * n, index=df.index)
+        else:
+            arr = np.full(n, v, dtype=object)
+        return pd.Series(arr, index=df.index)
     if isinstance(e, Col):
         name = e.name.split(".")[-1]
         if name not in df.columns:

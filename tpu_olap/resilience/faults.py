@@ -30,6 +30,13 @@ resilience layer must survive, not just the device dispatch:
     wal-truncate   after the manifest swap, before the WAL rewrite (the
                    log keeps pre-checkpoint frames; replay filters them
                    by the manifest watermark)
+    stage-plan     executor.stages boundary entering the plan stage of
+    stage-enqueue  a query's stage graph (and likewise for enqueue /
+    stage-transfer transfer / finalize / assemble / background) — fired
+    stage-finalize by StageScheduler.stage before the pool slot is
+    stage-assemble taken, so a fault here is a failure BETWEEN stages:
+    stage-background  after the previous stage committed its work, before
+                   the next one starts (docs/EXECUTION.md)
 
 Backwards compatibility: a plain callable (no ``stages`` attribute)
 fires ONLY at the classic ``dispatch`` site, exactly as before — every
@@ -51,7 +58,9 @@ LEGACY_STAGES = ("dispatch",)
 ALL_STAGES = ("dispatch", "host-transfer", "reprobe", "ingest",
               "batch-leg", "append", "wal-write", "wal-replay",
               "compact", "spill-write", "manifest-swap", "store-load",
-              "wal-truncate")
+              "wal-truncate", "stage-plan", "stage-enqueue",
+              "stage-transfer", "stage-finalize", "stage-assemble",
+              "stage-background")
 
 
 def maybe_inject(config, stage: str, attempt: int = 0) -> None:

@@ -45,6 +45,7 @@ import time
 
 import numpy as np
 
+from tpu_olap.obs.trace import span as _span
 from tpu_olap.resilience.errors import (IngestBackpressure, QueryShed,
                                         UserError)
 from tpu_olap.resilience.faults import maybe_inject
@@ -564,8 +565,10 @@ class IngestManager:
         self.config = engine.config
         self._lock = threading.Lock()
         self._states: dict[str, TableIngestState] = {}
-        self._wake = threading.Event()
-        self._compactor: threading.Thread | None = None
+        # the compactor is a scheduler-managed background stage graph
+        # (executor.stages.register_periodic), not a bespoke daemon
+        # thread — this is its PeriodicHandle
+        self._compact_handle = None
         self._stopped = False
         m = engine.metrics
         self._m_rows = m.counter(
@@ -641,7 +644,12 @@ class IngestManager:
                 wal_path(cfg.ingest_wal_dir, st.name),
                 fsync=cfg.ingest_wal_fsync,
                 flush_interval_s=cfg.ingest_wal_flush_interval_s,
-                start_seq=st.acked_seq)
+                start_seq=st.acked_seq,
+                # interval fsync rides the stage scheduler's background
+                # pool as a `wal-flush:<table>` periodic graph instead
+                # of one daemon thread per log
+                flush_scheduler=self.engine.runner.stages
+                .register_periodic)
         return st.wal
 
     # EWMA weight for the measured compactor drain rate; clamp bounds
@@ -718,8 +726,7 @@ class IngestManager:
             cap = int(cfg.ingest_max_delta_rows or 0)
             if cap and table.delta_rows + len(canon) > cap:
                 self._m_backpressure.inc(table=name)
-                self._ensure_compactor()
-                self._wake.set()
+                self._ensure_compactor(wake=True)
                 need = table.delta_rows + len(canon) - cap
                 raise IngestBackpressure(
                     f"delta for {name!r} holds {table.delta_rows} rows;"
@@ -765,8 +772,7 @@ class IngestManager:
             delta_rows=new_table.delta_rows, wal_seq=seq)
         if cfg.ingest_auto_compact and \
                 new_table.delta_rows >= int(cfg.ingest_compact_rows):
-            self._ensure_compactor()
-            self._wake.set()
+            self._ensure_compactor(wake=True)
         return {"table": name, "rows": len(canon),
                 "generation": new_table.generation,
                 "sealed_generation": new_table.sealed_generation,
@@ -955,8 +961,7 @@ class IngestManager:
             generation=entry.segments.generation)
         if cfg.ingest_auto_compact and entry.segments.delta_rows \
                 >= int(cfg.ingest_compact_rows):
-            self._ensure_compactor()
-            self._wake.set()
+            self._ensure_compactor(wake=True)
 
     def on_drop(self, name: str):
         with self._lock:
@@ -972,51 +977,56 @@ class IngestManager:
 
     # ---------------------------------------------------------- compactor
 
-    def _ensure_compactor(self):
+    def _ensure_compactor(self, wake: bool = False):
+        """Register the `compact` background graph on the stage
+        scheduler (lazily; re-registers after Engine.close cancelled
+        it). `wake=True` also requests an immediate pass — ingest
+        backpressure needs the compactor NOW, not at the next tick."""
         if self._stopped or not self.config.ingest_auto_compact:
             return
         with self._lock:
-            if self._compactor is not None \
-                    and self._compactor.is_alive():
-                return
-            t = threading.Thread(target=self._compact_loop,
-                                 name="tpu-olap-compactor", daemon=True)
-            self._compactor = t
-            t.start()
+            h = self._compact_handle
+            if h is None or h.cancelled:
+                h = self._compact_handle = \
+                    self.engine.runner.stages.register_periodic(
+                        "compact",
+                        lambda: self.config.ingest_compact_interval_s,
+                        self._compact_pass)
+        if wake:
+            h.wake()
 
-    def _compact_loop(self):
+    def _compact_pass(self):
+        """One background-graph tick: seal every delta past the row
+        threshold. Runs on the scheduler's background stage pool every
+        ingest_compact_interval_s (or on an append wake); compact_now
+        takes an admission slot and honors the breaker, so background
+        sealing queues/sheds WITH foreground traffic."""
         cfg = self.config
-        while not self._stopped:
-            self._wake.wait(
-                max(0.05, float(cfg.ingest_compact_interval_s)))
-            self._wake.clear()
+        with self._lock:
+            names = list(self._states)
+        for name in names:
             if self._stopped:
                 return
-            with self._lock:
-                names = list(self._states)
-            for name in names:
-                if self._stopped:
-                    return
+            try:
+                entry = self.engine.catalog.maybe(name)
+                if entry is None or not entry.is_accelerated:
+                    continue
+                if entry.segments.delta_rows \
+                        >= int(cfg.ingest_compact_rows):
+                    self.compact_now(name)
+            except QueryShed:
+                pass     # admission saturated: retry next tick
+            except Exception as e:  # noqa: BLE001 — retried, but
+                # never silently: a persistently failing compaction
+                # means the delta grows until every append sheds,
+                # and the operator needs a visible cause
+                self._m_compact_err.inc(table=name)
                 try:
-                    entry = self.engine.catalog.maybe(name)
-                    if entry is None or not entry.is_accelerated:
-                        continue
-                    if entry.segments.delta_rows \
-                            >= int(cfg.ingest_compact_rows):
-                        self.compact_now(name)
-                except QueryShed:
-                    pass     # admission saturated: retry next tick
-                except Exception as e:  # noqa: BLE001 — retried, but
-                    # never silently: a persistently failing compaction
-                    # means the delta grows until every append sheds,
-                    # and the operator needs a visible cause
-                    self._m_compact_err.inc(table=name)
-                    try:
-                        self.engine.runner.events.emit(
-                            "compact_error", table=name,
-                            error=f"{type(e).__name__}: {e}")
-                    except Exception:  # noqa: BLE001
-                        pass
+                    self.engine.runner.events.emit(
+                        "compact_error", table=name,
+                        error=f"{type(e).__name__}: {e}")
+                except Exception:  # noqa: BLE001
+                    pass
 
     def compact_now(self, name: str) -> dict | None:
         """Seal the table's delta (sync spelling; the compactor loop
@@ -1215,6 +1225,16 @@ class IngestManager:
         return out
 
     def _checkpoint_sealed(self, name: str, entry, st) -> dict:
+        """Checkpoint rides the stage graph too: chained after a
+        compaction it re-enters the background stage section for free
+        (same thread); invoked sync (the CHECKPOINT verb) it takes one
+        slot — either way the spill shows up as a `checkpoint` span
+        under background-stage occupancy accounting."""
+        with self.engine.runner.stages.stage("background"), \
+                _span("checkpoint"):
+            return self._checkpoint_commit(name, entry, st)
+
+    def _checkpoint_commit(self, name: str, entry, st) -> dict:
         """Spill the sealed scope + advance the manifest + truncate the
         WAL through the lag-one watermark. Serialized per table; a
         second caller while one runs reports "busy" (the compactor's
@@ -1354,11 +1374,12 @@ class IngestManager:
                 "wal": wal,
                 "store": store,
             }
+        h = self._compact_handle
         return {
             "tables": tables,
             "compactor": {
-                "running": self._compactor is not None
-                and self._compactor.is_alive(),
+                "running": h is not None and not h.cancelled,
+                "graph": h.snapshot() if h is not None else None,
                 "auto": bool(cfg.ingest_auto_compact),
                 "compact_rows": int(cfg.ingest_compact_rows),
                 "interval_s": float(cfg.ingest_compact_interval_s),
@@ -1405,25 +1426,25 @@ class IngestManager:
         return rows
 
     def stop(self):
-        """Deterministically stop + join the compactor and close every
-        WAL (Engine.close). Appends afterwards reopen WALs lazily; the
-        compactor restarts on the next append that wants it."""
+        """Deterministically cancel the compactor graph (joining an
+        in-progress pass) and close every WAL (Engine.close). Appends
+        afterwards reopen WALs lazily; the compactor graph re-registers
+        on the next append that wants it."""
         self._stopped = True
-        self._wake.set()
-        t = self._compactor
+        h = self._compact_handle
         joined = True
-        if t is not None:
-            t.join(timeout=10.0)
-            joined = not t.is_alive()
+        if h is not None:
+            h.cancel(join_timeout=10.0)
+            joined = not h.running
             if joined:
-                self._compactor = None
+                self._compact_handle = None
         with self._lock:
             states = list(self._states.values())
         for st in states:
             if st.wal is not None:
                 st.wal.close()
         if joined:
-            # re-arm: a later append may restart the compactor cleanly.
+            # re-arm: a later append may re-register the graph cleanly.
             # A join timeout (compaction wedged mid-rebuild) keeps the
             # stop flag set so the straggler exits at its next check
             # instead of being revived as a zombie.

@@ -21,7 +21,8 @@ fsync policy (`EngineConfig.ingest_wal_fsync`):
 
   "always"    fsync before acknowledging every append (default; the
               durability contract above holds against power loss)
-  "interval"  a background flusher thread fsyncs every
+  "interval"  a `wal-flush:<table>` background stage graph
+              (executor/stages.py) fsyncs every
               `ingest_wal_flush_interval_s`; appends acknowledge after
               the buffered OS write — process crashes lose nothing,
               power loss may lose the last interval (`synced_seq` in
@@ -181,11 +182,11 @@ def truncate_file_through(path: str, through_seq: int) -> int:
 class WriteAheadLog:
     """Append-only framed log for ONE table. Thread-safe; the engine's
     per-table ingest lock already serializes appends, the internal lock
-    just keeps the flusher thread and close() honest."""
+    just keeps the interval-flush graph and close() honest."""
 
     def __init__(self, path: str, fsync: str = "always",
                  flush_interval_s: float = 0.05,
-                 start_seq: int = 0):
+                 start_seq: int = 0, flush_scheduler=None):
         self.path = path
         self.fsync_mode = str(fsync)
         self.flush_interval_s = max(0.005, float(flush_interval_s))
@@ -200,13 +201,17 @@ class WriteAheadLog:
         # honestly acknowledged until the log is reset
         self.tainted = False
         self.bytes_written = os.path.getsize(path)
-        self._flusher: threading.Thread | None = None
-        self._flush_wake = threading.Event()
-        if self.fsync_mode == "interval":
-            self._flusher = threading.Thread(
-                target=self._flush_loop, daemon=True,
-                name=f"tpu-olap-wal-{os.path.basename(path)}")
-            self._flusher.start()
+        # interval fsync runs as a periodic background stage graph:
+        # `flush_scheduler` is StageScheduler.register_periodic (wired
+        # by IngestManager._wal_for) instead of one daemon thread per
+        # log. With no scheduler, interval mode degrades to fsync on
+        # append — strictly MORE durable, never silently lagging.
+        self._flush_handle = None
+        if self.fsync_mode == "interval" and flush_scheduler is not None:
+            self._flush_handle = flush_scheduler(
+                f"wal-flush:{os.path.basename(path)}",
+                lambda: self.flush_interval_s,
+                self._flush_once)
 
     # ------------------------------------------------------------- write
 
@@ -236,7 +241,9 @@ class WriteAheadLog:
             try:
                 self._f.write(frame)
                 self._f.flush()
-                if self.fsync_mode == "always":
+                if self.fsync_mode == "always" or (
+                        self.fsync_mode == "interval"
+                        and self._flush_handle is None):
                     os.fsync(self._f.fileno())
                     self._synced_seq = seq
             except Exception:
@@ -257,8 +264,9 @@ class WriteAheadLog:
                 raise
             self._seq = seq
             self.bytes_written += len(frame)
-        if self.fsync_mode == "interval":
-            self._flush_wake.set()
+        h = self._flush_handle
+        if h is not None:
+            h.wake()
         return seq, self.bytes_written
 
     def sync(self):
@@ -270,20 +278,19 @@ class WriteAheadLog:
             os.fsync(self._f.fileno())
             self._synced_seq = self._seq
 
-    def _flush_loop(self):
-        while True:
-            self._flush_wake.wait(self.flush_interval_s)
-            self._flush_wake.clear()
-            with self._lock:
-                if self._closed:
-                    return
-                if self._synced_seq != self._seq:
-                    try:
-                        self._f.flush()
-                        os.fsync(self._f.fileno())
-                        self._synced_seq = self._seq
-                    except (OSError, ValueError):
-                        pass  # retried next tick; synced_seq shows lag
+    def _flush_once(self):
+        """One interval-fsync tick (the `wal-flush:<table>` background
+        graph's body): fsync iff frames landed since the last sync."""
+        with self._lock:
+            if self._closed:
+                return
+            if self._synced_seq != self._seq:
+                try:
+                    self._f.flush()
+                    os.fsync(self._f.fileno())
+                    self._synced_seq = self._seq
+                except (OSError, ValueError):
+                    pass  # retried next tick; synced_seq shows lag
 
     def truncate_through(self, through_seq: int) -> int:
         """Atomically drop frames with seq <= `through_seq` — they are
@@ -336,8 +343,9 @@ class WriteAheadLog:
             self.tainted = False
 
     def close(self, final_sync: bool = True):
-        """Flush, fsync, stop the flusher, close the file. Idempotent;
-        joins the flusher thread so Engine.close() is deterministic."""
+        """Flush, fsync, cancel the flush graph, close the file.
+        Idempotent; joins an in-progress flush tick so Engine.close()
+        is deterministic."""
         with self._lock:
             if self._closed:
                 return
@@ -350,10 +358,10 @@ class WriteAheadLog:
             except (OSError, ValueError):
                 pass
             self._f.close()
-        self._flush_wake.set()
-        if self._flusher is not None:
-            self._flusher.join(timeout=5.0)
-            self._flusher = None
+        h = self._flush_handle
+        if h is not None:
+            h.cancel(join_timeout=5.0)
+            self._flush_handle = None
 
     def delete(self):
         """close + unlink (DROP TABLE cascade)."""
