@@ -400,7 +400,14 @@ def main(span_summary: bool = False, inject_faults: int | None = None,
             "segment_store_mb": ctx["stored_mb"],
             "hbm": {"budget_bytes": ctx["hbm_budget"],
                     "bytes_in_use": ledger.bytes_in_use,
-                    "evictions": ledger.evictions},
+                    "evictions": ledger.evictions,
+                    # telemetry-plane census (ISSUE 17): high-watermark
+                    # growth between runs is a regression the compare
+                    # gate catches even when steady-state bytes match
+                    "high_watermark_bytes": ledger.watermarks()["total"],
+                    "per_chip_high_watermark_bytes":
+                        ledger.watermarks()["per_chip"]},
+            "alerts": eng.runner.sentinel.counts(),
             **({"per_query_phase_p50_ms": phase_ms}
                if span_summary else {}),
             **({"trace_out": trace_out} if trace_out else {}),

@@ -101,7 +101,13 @@ def load_artifact(path: str) -> dict:
             _fail(f"{path}: non-numeric p50 entry: {e}")
 
     out = {"kind": "latency", "p50": _floats(per_query), "warm": None,
-           "hit_rate": None}
+           "hit_rate": None, "hbm_hwm": None}
+    hbm = detail.get("hbm")
+    if isinstance(hbm, dict) and \
+            hbm.get("high_watermark_bytes") is not None:
+        # telemetry-plane census (ISSUE 17): artifacts banked before
+        # the sampler existed have no watermark — skipped, never gated
+        out["hbm_hwm"] = float(hbm["high_watermark_bytes"])
     cache = detail.get("cache")
     if isinstance(cache, dict):
         warm = cache.get("per_query_warm_p50_ms")
@@ -293,15 +299,32 @@ def main(argv=None) -> int:
         print(f"{q:<{w}}  {'-':>10}  {new[q]:>10.3f}  {'':>8}  "
               "only in candidate")
 
+    # HBM high-watermark gate (ISSUE 17): peak device-memory growth
+    # past the threshold is a regression even when steady-state bytes
+    # and p50s hold — a transient spike is tomorrow's OOM. Gated only
+    # when BOTH artifacts carry the watermark (older artifacts skip).
+    have_hwm = base_art.get("hbm_hwm") is not None \
+        and new_art.get("hbm_hwm") is not None
+    if have_hwm:
+        bh, nh = base_art["hbm_hwm"], new_art["hbm_hwm"]
+        dh = (nh - bh) / bh if bh > 0 else 0.0
+        hwm_reg = dh > args.threshold
+        print(f"{'hbm_hwm_bytes':<{w}}  {bh:>10.0f}  {nh:>10.0f}  "
+              f"{dh:>+7.1%}" + ("  " * (3 if have_cache else 0))
+              + f"  {'REGRESSED(hbm_hwm)' if hwm_reg else 'ok'}")
+        if hwm_reg:
+            regressions.append("hbm_hwm")
+
     if regressions:
-        print(f"\nbench_compare: {len(regressions)} quer"
-              f"{'y' if len(regressions) == 1 else 'ies'} regressed "
+        print(f"\nbench_compare: {len(regressions)} metric"
+              f"{'' if len(regressions) == 1 else 's'} regressed "
               f"past {args.threshold:.0%}: {', '.join(regressions)}",
               file=sys.stderr)
         return 1
     print(f"\nbench_compare: ok ({len(rows)} queries within "
           f"{args.threshold:.0%}"
           + (", warm path + hit rate checked" if have_cache else "")
+          + (", hbm high-watermark checked" if have_hwm else "")
           + ")")
     return 0
 

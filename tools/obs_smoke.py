@@ -1,0 +1,151 @@
+"""Telemetry-plane smoke (CI `obs-smoke` job; ISSUE 17): boot a live
+QueryServer and prove the self-monitoring loop end to end over HTTP —
+
+1. the background telemetry graph samples the metrics registry into
+   sys.metrics_history (queried via ordinary SQL over POST /sql, which
+   must itself never self-attribute into the workload/sentinel stats);
+2. GET /debug/health answers ok while the engine is healthy;
+3. an induced transfer-stage slowdown (FaultInjector latency mode)
+   fires a latency_drift alert NAMING the transfer stage, visible in
+   /debug/health and the alerts_active{kind} gauge — and auto-clears
+   after the condition stops;
+4. a W3C `traceparent` request header round-trips: echoed on the
+   response and stamped on the query's history record.
+
+Exits non-zero on any violation. Seconds-scale — a pre-merge gate,
+not a bench (docs/OBSERVABILITY.md "Telemetry plane")."""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+TRACEPARENT = "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01"
+
+
+def main() -> int:
+    from tpu_olap.utils.platform import force_cpu_devices
+    force_cpu_devices(1)
+    import numpy as np
+    import pandas as pd
+
+    from tpu_olap import Engine
+    from tpu_olap.api.server import QueryServer
+    from tpu_olap.executor import EngineConfig
+    from tpu_olap.resilience.faults import FaultInjector
+
+    cfg = EngineConfig(
+        telemetry_interval_s=0.2,       # fast sampler for the smoke
+        sentinel_min_samples=3,
+        sentinel_latency_factor=2.0,
+        sentinel_latency_floor_ms=5.0,
+        sentinel_clear_after_s=1.0,     # observable fire -> clear
+    )
+    eng = Engine(cfg)
+    rng = np.random.default_rng(7)
+    n = 40_000
+    eng.register_table("sales", pd.DataFrame({
+        "ts": pd.to_datetime("1996-01-01") + pd.to_timedelta(
+            rng.integers(0, 86400 * 365, n), unit="s"),
+        "cat": rng.choice([f"c{i}" for i in range(8)], n),
+        "v": rng.integers(0, 10_000, n).astype(np.int64),
+    }), time_column="ts")
+    srv = QueryServer(eng, port=0).start()
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def post(path, payload, headers=None):
+        req = urllib.request.Request(
+            base + path, json.dumps(payload).encode(),
+            {"Content-Type": "application/json", **(headers or {})})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.load(r), dict(r.headers)
+
+    def get(path):
+        with urllib.request.urlopen(base + path, timeout=30) as r:
+            return (json.load(r) if "json" in r.headers.get(
+                "Content-Type", "") else r.read().decode())
+
+    try:
+        # -- 4: traceparent round-trip, and a first query batch to
+        # build the sentinel's per-template latency baseline. Literals
+        # vary so the result cache cannot short-circuit the stages.
+        for i in range(8):
+            body, hdrs = post(
+                "/sql",
+                {"query": "SELECT cat, SUM(v) FROM sales "
+                          f"WHERE v < {9000 + i} GROUP BY cat"},
+                {"traceparent": TRACEPARENT})
+            assert body["rows"], "query returned no rows"
+            assert hdrs.get("traceparent") == TRACEPARENT, \
+                f"traceparent not echoed: {hdrs}"
+        rec = [m for m in list(eng.history) if m.get("traceparent")]
+        assert rec and rec[-1]["traceparent"] == TRACEPARENT, \
+            "traceparent missing from the query record"
+        # an invalid header is ignored, never echoed, never an error
+        _, hdrs = post("/sql", {"query": "SELECT COUNT(*) FROM sales"},
+                       {"traceparent": "not-a-traceparent"})
+        assert "traceparent" not in {k.lower() for k in hdrs}, \
+            "invalid traceparent must not echo"
+
+        # -- 1: the background sampler has ticked and sys.metrics_history
+        # serves over ordinary SQL, without self-attribution
+        deadline = time.time() + 10
+        while time.time() < deadline and eng.runner.telemetry.samples < 2:
+            time.sleep(0.1)
+        assert eng.runner.telemetry.samples >= 2, "sampler never ticked"
+        observed_before = eng.runner.sentinel.observed
+        body, _ = post("/sql", {
+            "query": "SELECT name, kind, value FROM sys.metrics_history "
+                     "LIMIT 20"})
+        assert len(body["rows"]) == 20, \
+            f"sys.metrics_history empty: {len(body['rows'])} rows"
+        assert eng.runner.sentinel.observed == observed_before, \
+            "introspection leaked into the sentinel's baselines"
+        ts = get("/debug/timeseries?n=2")
+        assert ts["series"] > 0 and all(
+            len(s["points"]) <= 2 for s in ts["timeseries"]), \
+            "/debug/timeseries ?n= cap violated"
+
+        # -- 2: healthy verdict before any fault
+        h = get("/debug/health")
+        assert h["ok"] and not h["alerts"], f"unexpectedly unwell: {h}"
+
+        # -- 3: induced transfer-stage slowdown -> latency_drift alert
+        # naming the stage, then auto-clear once the fault stops
+        cfg.fault_injector = FaultInjector(
+            rate=1.0, stages={"stage-transfer"}, latency_s=0.6)
+        for i in range(2):
+            post("/sql", {"query": "SELECT cat, SUM(v) FROM sales "
+                                   f"WHERE v < {800 + i} GROUP BY cat"})
+        cfg.fault_injector = None
+        h = get("/debug/health")
+        assert not h["ok"], "induced slowdown did not trip health"
+        kinds = {(a["kind"], a.get("stage")) for a in h["alerts"]}
+        assert ("latency_drift", "transfer") in kinds, \
+            f"drift not attributed to transfer: {h['alerts']}"
+        metrics = get("/metrics")
+        assert 'alerts_active{kind="latency_drift"} 1' in metrics, \
+            "alerts_active gauge not raised"
+        deadline = time.time() + 15
+        while time.time() < deadline and not get("/debug/health")["ok"]:
+            time.sleep(0.2)
+        h = get("/debug/health")
+        assert h["ok"], f"alert never cleared: {h}"
+        rows, _ = post("/sql", {
+            "query": "SELECT kind, stage, status FROM sys.alerts"})
+        assert any(r["status"] == "cleared" and r["stage"] == "transfer"
+                   for r in rows["rows"]), \
+            f"cleared alert missing from sys.alerts: {rows}"
+    finally:
+        srv.stop()
+    print("obs_smoke: ok (sampler + health + drift attribution + "
+          "auto-clear + traceparent round-trip)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,7 +18,8 @@ from tpu_olap.obs.workload import (fingerprint_sql,
 from tpu_olap.executor import EngineConfig, QueryRunner
 from tpu_olap.obs.trace import (Trace, current_query_id,
                                 in_nested_execution, nested_execution,
-                                span as _span, use_query_id)
+                                parse_traceparent, span as _span,
+                                use_query_id, use_traceparent)
 from tpu_olap.executor.dimplan import UnsupportedDimension
 from tpu_olap.executor.runner import QueryResult
 from tpu_olap.ir.serde import query_from_json
@@ -117,6 +118,22 @@ class Engine:
         # segments under the admission/breaker machinery
         from tpu_olap.segments.delta import IngestManager
         self.ingest = IngestManager(self)
+        # WAL sync-lag probe for the regression sentinel (obs.sentinel;
+        # ISSUE 17): per-table unsynced frame counts from the ingest
+        # snapshot, consulted on the telemetry tick — wired here
+        # because the runner (which owns the sentinel) predates the
+        # ingest manager
+        self.runner.sentinel.add_probe("wal", self._wal_lag_probe)
+
+    def _wal_lag_probe(self) -> dict:
+        """{table: unsynced WAL frames} for tables with live WALs."""
+        out = {}
+        snap = self.ingest.snapshot() or {}
+        for name, st in (snap.get("tables") or {}).items():
+            wal = st.get("wal") if isinstance(st, dict) else None
+            if wal and wal.get("lag_records") is not None:
+                out[name] = int(wal["lag_records"])
+        return out
 
     # ------------------------------------------------------- registration
 
@@ -245,7 +262,8 @@ class Engine:
         self.cubes.on_table_registered(name)
         return entry
 
-    def append(self, table: str, rows) -> dict:
+    def append(self, table: str, rows,
+               traceparent: str | None = None) -> dict:
         """Real-time append (docs/INGEST.md): `rows` (list of dicts or
         a DataFrame, columns ⊆ the table's schema, time under the
         registered time column or ``__time``) land in the table's
@@ -259,8 +277,14 @@ class Engine:
         ``INSERT INTO t (cols) VALUES (...)``; HTTP: ``POST /ingest``.
 
         Returns {table, rows, generation, sealed_generation,
-        delta_rows, watermark, wal_seq}."""
-        return self.ingest.append(table, rows)
+        delta_rows, watermark, wal_seq}. A valid W3C `traceparent`
+        (ISSUE 17) is stamped into the ack and the emitted events."""
+        tp = parse_traceparent(traceparent)
+        with use_traceparent(tp["traceparent"] if tp else None):
+            ack = self.ingest.append(table, rows)
+        if tp is not None and isinstance(ack, dict):
+            ack.setdefault("traceparent", tp["traceparent"])
+        return ack
 
     def compact_now(self, table: str | None = None):
         """Synchronously seal delta rows into time-partitioned sealed
@@ -313,9 +337,19 @@ class Engine:
         """
         return self._sql_traced(query)[0]
 
-    def _sql_traced(self, query: str):
+    def _sql_traced(self, query: str, traceparent: str | None = None):
         """sql() plus the completed trace (None for statement verbs or
-        when tracing is off) — the EXPLAIN ANALYZE entry point."""
+        when tracing is off) — the EXPLAIN ANALYZE entry point.
+
+        `traceparent` (ISSUE 17): a W3C trace-context header value from
+        the HTTP edge. A valid header is stamped on the root span and
+        the query record (distributed-trace join key); an invalid one
+        is ignored — trace propagation must never fail a query."""
+        tp = parse_traceparent(traceparent)
+        with use_traceparent(tp["traceparent"] if tp else None):
+            return self._sql_traced_inner(query, tp)
+
+    def _sql_traced_inner(self, query: str, tp: dict | None = None):
         verb = _match_verb(query)
         if verb is not None:
             return verb(self), None
@@ -338,6 +372,10 @@ class Engine:
                 return self._execute_sys_stmt(pre_stmt), None
         with self.tracer.trace("sql") as root:
             root.set(sql=query)
+            if tp is not None:
+                root.set(traceparent=tp["traceparent"],
+                         trace_id=tp["trace_id"],
+                         parent_span_id=tp["parent_id"])
             try:
                 with root.span("parse"):
                     stmt = pre_stmt if pre_stmt is not None \
@@ -585,11 +623,17 @@ class Engine:
         back in input order."""
         return self.sql_batch_ids(queries)[0]
 
-    def sql_batch_ids(self, queries):
+    def sql_batch_ids(self, queries, traceparent: str | None = None):
         """sql_batch plus each statement's query_id (parallel to the
         results) — the ids the /sql/batch X-Query-Id header carries so
         clients can correlate responses with /debug/queries,
-        sys.queries, and Perfetto traces."""
+        sys.queries, and Perfetto traces. A valid W3C `traceparent`
+        covers every statement in the submission (ISSUE 17)."""
+        tp = parse_traceparent(traceparent)
+        with use_traceparent(tp["traceparent"] if tp else None):
+            return self._sql_batch_ids_inner(queries, tp)
+
+    def _sql_batch_ids_inner(self, queries, tp: dict | None = None):
         queries = list(queries)
         outs: list = [None] * len(queries)
         plans: dict[int, object] = {}
@@ -599,6 +643,10 @@ class Engine:
         qids = [self.tracer.new_query_id() for _ in queries]
         with self.tracer.trace("sql_batch") as root:
             root.set(statements=len(queries))
+            if tp is not None:
+                root.set(traceparent=tp["traceparent"],
+                         trace_id=tp["trace_id"],
+                         parent_span_id=tp["parent_id"])
             for i, q in enumerate(queries):
                 verb = _match_verb(q)
                 if verb is not None:

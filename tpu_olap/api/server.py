@@ -237,8 +237,9 @@ class QueryServer:
             def do_POST(self):
                 server._enter()
                 try:
-                    payload, headers = server._post(self.path,
-                                                    self._body())
+                    payload, headers = server._post(
+                        self.path, self._body(),
+                        traceparent=self.headers.get("traceparent"))
                     self._send(200, payload, headers)
                 except QueryError as e:
                     # taxonomy first: UserError IS a ValueError and
@@ -407,6 +408,24 @@ class QueryServer:
             # SQL spelling of the per-segment half is
             # SELECT * FROM sys.segments (kind/watermark columns)
             return self.engine.ingest.snapshot()
+        if path == "/debug/timeseries" \
+                or path.startswith("/debug/timeseries?"):
+            # the telemetry plane's metrics history (obs.timeseries;
+            # ISSUE 17): bounded per-series rings sampled from the
+            # metrics registry on the background telemetry graph. ?n=
+            # caps points per series — the SQL spelling is
+            # SELECT * FROM sys.metrics_history
+            n = _int_param(_parse_query(path), ("n", "limit"))
+            return self.engine.runner.telemetry.snapshot(
+                limit_per_series=n)
+        if path == "/debug/health" or path.startswith("/debug/health?"):
+            # regression-sentinel verdict (obs.sentinel; ISSUE 17):
+            # ok=false while any structured alert (latency drift with
+            # stage attribution, HBM pressure, eviction thrash, WAL
+            # lag, open breaker, admission sheds) is active — the SQL
+            # spelling is SELECT * FROM sys.alerts. Always HTTP 200:
+            # /readyz answers "can I serve", this answers "am I well"
+            return self.engine.runner.sentinel.health()
         if path == "/debug/cache" or path.startswith("/debug/cache?"):
             # semantic result-cache state (executor.resultcache;
             # docs/CACHING.md): per-tier entries/bytes/hit counters plus
@@ -441,27 +460,36 @@ class QueryServer:
         m.gauge("slo_burn_rate").set(eng.runner.slo.burn_rate())
         return m.render()
 
-    def _post(self, path: str, body: str):
+    def _post(self, path: str, body: str, traceparent: str | None = None):
         """(payload, headers) for a POST. /sql and /sql/batch answer
         with an X-Query-Id header (ISSUE 11 satellite) so a client can
         correlate a response with /debug/queries, SELECT ... FROM
-        sys.queries, and Perfetto traces."""
+        sys.queries, and Perfetto traces. A valid W3C `traceparent`
+        request header (ISSUE 17) joins the query records and span
+        trees to the caller's distributed trace and is echoed back on
+        the response; an invalid one is ignored, never an error."""
+        from tpu_olap.obs.trace import parse_traceparent
+        tp = parse_traceparent(traceparent)
+        tp_headers = [("traceparent", tp["traceparent"])] if tp else []
         if path == "/sql":
             req = json.loads(body)
-            frame, trace = self.engine._sql_traced(req["query"])
+            frame, trace = self.engine._sql_traced(
+                req["query"], traceparent=traceparent)
             headers = [("X-Query-Id", trace.query_id)] \
                 if trace is not None else []
             return {"columns": list(frame.columns),
-                    "rows": frame.to_dict("records")}, headers
+                    "rows": frame.to_dict("records")}, \
+                headers + tp_headers
         if path == "/sql/batch":
             # explicit batch submission: one POST, N statements, shared
             # scans where compatible (Engine.sql_batch / executor.batch)
             req = json.loads(body)
-            frames, qids = self.engine.sql_batch_ids(req["queries"])
+            frames, qids = self.engine.sql_batch_ids(
+                req["queries"], traceparent=traceparent)
             return {"results": [{"columns": list(f.columns),
                                  "rows": f.to_dict("records")}
                                 for f in frames]}, \
-                [("X-Query-Id", ",".join(qids))]
+                [("X-Query-Id", ",".join(qids))] + tp_headers
         if path in ("/druid/v2", "/druid/v2/"):
             spec = json.loads(body)
             res = self.engine.execute_ir(spec)
@@ -474,7 +502,9 @@ class QueryServer:
             if "table" not in req or "rows" not in req:
                 raise UserError(
                     "/ingest expects {\"table\": ..., \"rows\": [...]}")
-            return self.engine.append(req["table"], req["rows"]), []
+            return self.engine.append(
+                req["table"], req["rows"],
+                traceparent=traceparent), tp_headers
         if path == "/debug/profile" or path.startswith("/debug/profile?"):
             # on-demand device capture: blocks THIS handler thread for
             # the window while other threads keep serving (their

@@ -30,7 +30,16 @@ Datasources (column tables in docs/OBSERVABILITY.md):
   sys.devices          per-chip serving state under the interleaved
                        segment placement (executor/sharding.py):
                        segments owned, resident bytes, dispatch
-                       participation, tier-1 cache-shard entries
+                       participation, tier-1 cache-shard entries,
+                       per-(chip, owner-class) HBM bytes with
+                       high-watermark + headroom (ISSUE 17)
+  sys.metrics_history  the telemetry sampler's bounded per-series
+                       rings (obs.timeseries) — the engine answers
+                       SQL over its own recent metric history
+  sys.alerts           the regression sentinel's alert history
+                       (obs.sentinel): latency drift attributed to a
+                       stage, HBM pressure, eviction thrash, WAL lag,
+                       breaker/admission events
 """
 
 from __future__ import annotations
@@ -225,7 +234,10 @@ def _checkpoints_frame(engine) -> pd.DataFrame:
 _DEVICE_COLS = (
     "index", "device", "platform", "process", "chips", "segments",
     "resident_bytes", "dispatches", "cache_shard_entries",
-    "rebased_cols", "rebase_rows_uploaded")
+    "rebased_cols", "rebase_rows_uploaded", "hbm_bytes",
+    "table_column_bytes", "cube_table_bytes", "inflight_bytes",
+    "cache_pin_bytes", "hbm_high_watermark_bytes",
+    "hbm_headroom_bytes")
 
 
 def _devices_frame(engine) -> pd.DataFrame:
@@ -235,9 +247,45 @@ def _devices_frame(engine) -> pd.DataFrame:
     participation, and tier-1 cache-SHARD entry counts (an entry's chip
     is its segment's placement owner). `rebased_*` columns surface the
     incremental re-place path (only delta-touched segments' rows
-    re-upload on an ingest snapshot swap)."""
+    re-upload on an ingest snapshot swap). The hbm_* columns (ISSUE
+    17) are the ledger's exact per-(chip, owner-class) attribution:
+    table_column + cube_table + inflight bytes sum to hbm_bytes (and
+    across chips to HbmLedger.bytes_in_use); cache_pin_bytes is the
+    tier-1 ResultCache's per-chip byte census; high-watermark and
+    headroom are against the per-chip share of hbm_budget_bytes."""
     return pd.DataFrame(engine.runner.device_snapshot(),
                         columns=list(_DEVICE_COLS))
+
+
+_METRICS_HISTORY_COLS = ("ts_ms", "name", "kind", "labels", "value",
+                         "count")
+
+
+def _metrics_history_frame(engine) -> pd.DataFrame:
+    """sys.metrics_history: the telemetry sampler's bounded per-series
+    rings (obs.timeseries; ISSUE 17) — one row per retained sample.
+    Scalar series carry `value` (the counter/gauge level at ts_ms);
+    histogram series carry (`value`=observation sum, `count`=n), the
+    _sum/_count pair rates and means derive from. The engine answers
+    SQL over its own recent telemetry with no external TSDB."""
+    return pd.DataFrame(engine.runner.telemetry.rows(),
+                        columns=list(_METRICS_HISTORY_COLS))
+
+
+_ALERT_COLS = ("alert_id", "kind", "subject", "stage", "status",
+               "fired_at_ms", "last_seen_ms", "cleared_at_ms", "count",
+               "total_ms", "baseline_ms", "threshold_ms")
+
+
+def _alerts_frame(engine) -> pd.DataFrame:
+    """sys.alerts: the regression sentinel's alert history (active +
+    cleared, obs.sentinel; ISSUE 17). `stage` names the attributed
+    stage for latency_drift alerts; resource alerts (hbm_pressure,
+    eviction_thrash, wal_lag, breaker_open, admission_shed) carry
+    their condition under subject/count."""
+    rows = [{c: a.get(c) for c in _ALERT_COLS}
+            for a in engine.runner.sentinel.alert_rows()]
+    return pd.DataFrame(rows, columns=list(_ALERT_COLS))
 
 
 def _caches_frame(engine) -> pd.DataFrame:
@@ -281,6 +329,8 @@ class SysTableProvider:
         "sys.cubes": _cubes_frame,
         "sys.checkpoints": _checkpoints_frame,
         "sys.devices": _devices_frame,
+        "sys.metrics_history": _metrics_history_frame,
+        "sys.alerts": _alerts_frame,
     }
 
     def __init__(self, engine):

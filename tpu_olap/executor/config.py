@@ -380,6 +380,40 @@ class EngineConfig:
     workload_profile_enabled: bool = True
     workload_max_templates: int = 512
     workload_latency_window: int = 512
+    # telemetry plane (obs.timeseries + obs.sentinel; ISSUE 17): a
+    # periodic `telemetry` background graph on the stage scheduler
+    # snapshots every counter/gauge family into bounded per-series
+    # rings (sys.metrics_history / GET /debug/timeseries) and runs the
+    # regression sentinel's drift checks. interval <= 0 disables the
+    # graph; retention bounds each series ring.
+    telemetry_enabled: bool = True
+    telemetry_interval_s: float = 5.0
+    telemetry_retention: int = 360
+    # regression sentinel (obs.sentinel): EWMA + moment-sketch
+    # baselines per query template and per stage; a served query
+    # slower than max(floor, factor * baseline) after `min_samples`
+    # warmup raises a latency_drift alert attributed to the stage
+    # whose busy/wait moved most. Resource alerts (hbm_pressure,
+    # eviction_thrash, wal_lag, breaker_open, admission_shed) fire on
+    # the telemetry tick; an alert not re-confirmed for clear_after_s
+    # clears. alerts surface as events + alerts_active{kind} +
+    # sys.alerts + GET /debug/health.
+    sentinel_enabled: bool = True
+    sentinel_min_samples: int = 8
+    sentinel_ewma_alpha: float = 0.2
+    sentinel_latency_factor: float = 3.0
+    sentinel_latency_floor_ms: float = 10.0
+    sentinel_clear_after_s: float = 60.0
+    sentinel_hbm_pressure: float = 0.90   # of hbm_budget_bytes
+    sentinel_eviction_thrash: int = 32    # evictions per tick
+    sentinel_wal_lag_records: int = 1024  # unsynced WAL frames
+    sentinel_alert_limit: int = 256       # sys.alerts history ring
+    # event-log JSONL sink rotation (obs.events): when the sink file
+    # exceeds max_bytes it rotates to path.1 (shifting .1 -> .2 ...,
+    # keeping `keep` rotated files) and emits a sink_rotate event.
+    # 0 disables rotation (the pre-ISSUE-17 unbounded behavior).
+    event_log_max_bytes: int = 64 * 1024 * 1024
+    event_log_rotate_keep: int = 3
 
     # Pallas fused one-hot MXU reduce (kernels.pallas_reduce): "auto" uses
     # it on the TPU backend for eligible plans, "force" uses it everywhere

@@ -35,7 +35,7 @@ class HbmLedger:
     transfer is about to read them). Mutations are internally locked:
     stage-2 unpins run lock-free with respect to dispatch_lock."""
 
-    def __init__(self, budget_bytes: int | None):
+    def __init__(self, budget_bytes: int | None, num_chips: int = 1):
         self.budget = budget_bytes
         self._entries: OrderedDict[tuple, tuple[int, object]] = \
             OrderedDict()  # key -> (nbytes, evict_fn)
@@ -43,6 +43,121 @@ class HbmLedger:
         self._mu = threading.RLock()
         self.bytes_in_use = 0
         self.evictions = 0
+        # per-(chip, owner-class) attribution (ISSUE 17): under a mesh
+        # every ledgered buffer is sharded EXACTLY 1/num_chips per chip
+        # (DeviceDataset pads the segment axis to a multiple of D), so
+        # a per-entry even split is the true placement, not an
+        # estimate. Shares distribute any byte remainder to the lowest
+        # chips deterministically, so per-chip sums always equal
+        # bytes_in_use exactly. High-watermarks track ledger-managed
+        # bytes at mutation time; external reporters (tier-1 cache
+        # pins) are pulled live at breakdown time.
+        self.num_chips = max(1, int(num_chips))
+        self._chip_bytes = [0] * self.num_chips
+        self._chip_hwm = [0] * self.num_chips
+        self.high_watermark = 0
+        self._by_chip_owner: dict[tuple, int] = {}
+        self._external: dict = {}  # owner -> fn(num_chips) -> {chip: b}
+
+    # ------------------------------------------- per-chip attribution
+
+    @staticmethod
+    def _owner_for(key) -> str:
+        """Owner class of a ledger key: in-flight result pins, cube
+        tables (catalog name `__cube_<name>`), or ordinary table
+        columns (col/null/derived stacks)."""
+        head = str(key[0]) if key else ""
+        if head == "__inflight__":
+            return "inflight"
+        if head.startswith("__cube"):
+            return "cube_tables"
+        return "table_columns"
+
+    def _shares(self, nbytes: int) -> list:
+        q, r = divmod(int(nbytes), self.num_chips)
+        return [q + (1 if c < r else 0) for c in range(self.num_chips)]
+
+    def _account(self, key, nbytes: int, sign: int):
+        """Incremental per-(chip, owner) bookkeeping; caller holds _mu
+        and has already updated bytes_in_use."""
+        owner = self._owner_for(key)
+        for c, share in enumerate(self._shares(nbytes)):
+            self._chip_bytes[c] += sign * share
+            k = (c, owner)
+            nb = self._by_chip_owner.get(k, 0) + sign * share
+            if nb:
+                self._by_chip_owner[k] = nb
+            else:
+                self._by_chip_owner.pop(k, None)
+            if sign > 0 and self._chip_bytes[c] > self._chip_hwm[c]:
+                self._chip_hwm[c] = self._chip_bytes[c]
+        if sign > 0 and self.bytes_in_use > self.high_watermark:
+            self.high_watermark = self.bytes_in_use
+
+    def set_num_chips(self, num_chips: int):
+        """Adopt the mesh's chip count (the runner learns it when the
+        mesh is built, after the ledger exists) and re-attribute every
+        live entry under the new split. Watermarks reset to the current
+        totals — a high-watermark against a different chip count is not
+        comparable."""
+        d = max(1, int(num_chips))
+        with self._mu:
+            if d == self.num_chips:
+                return
+            self.num_chips = d
+            self._chip_bytes = [0] * d
+            self._by_chip_owner = {}
+            for k, (nbytes, _fn) in self._entries.items():
+                self._account(k, nbytes, +1)
+            for k, nbytes in self._inflight.items():
+                self._account(k, nbytes, +1)
+            self._chip_hwm = list(self._chip_bytes)
+            self.high_watermark = max(self.high_watermark,
+                                      self.bytes_in_use)
+
+    def register_external(self, owner: str, fn):
+        """Register a live per-chip byte reporter folded into
+        breakdown() under `owner` (tier-1 cache pins: the ResultCache
+        owns those buffers and their eviction policy, so the ledger
+        reports rather than manages them). fn(num_chips) -> {chip:
+        bytes}."""
+        with self._mu:
+            self._external[owner] = fn
+
+    def breakdown(self) -> dict:
+        """{(chip, owner-class): bytes} — ledger-managed classes
+        (table_columns, cube_tables, inflight) plus external reporters
+        (cache_pins). The ledger-managed slice sums EXACTLY to
+        bytes_in_use; the whole breakdown sums to total_bytes()."""
+        with self._mu:
+            out = dict(self._by_chip_owner)
+            external = dict(self._external)
+            d = self.num_chips
+        for owner, fn in external.items():
+            try:
+                per_chip = fn(d) or {}
+            except Exception:  # noqa: BLE001 — accounting, not serving
+                continue
+            for c, nbytes in per_chip.items():
+                if nbytes:
+                    k = (int(c), owner)
+                    out[k] = out.get(k, 0) + int(nbytes)
+        return out
+
+    def total_bytes(self) -> int:
+        """bytes_in_use plus external (cache-pin) bytes — what
+        breakdown() sums to."""
+        snap = self.breakdown()
+        with self._mu:
+            core = self.bytes_in_use
+        return core + sum(b for (_c, o), b in snap.items()
+                          if o in self._external)
+
+    def watermarks(self) -> dict:
+        """Ledger-managed high-watermarks, total and per chip."""
+        with self._mu:
+            return {"total": self.high_watermark,
+                    "per_chip": list(self._chip_hwm)}
 
     @property
     def inflight_bytes(self) -> int:
@@ -64,10 +179,12 @@ class HbmLedger:
                         continue
                     n, fn = self._entries.pop(k)
                     self.bytes_in_use -= n
+                    self._account(k, n, -1)
                     self.evictions += 1
                     fn()
             self._entries[key] = (nbytes, evict_fn)
             self.bytes_in_use += nbytes
+            self._account(key, nbytes, +1)
 
     def pin_inflight(self, key, nbytes: int):
         """Account a dispatch's not-yet-transferred output buffers:
@@ -76,18 +193,21 @@ class HbmLedger:
         with self._mu:
             self._inflight[key] = int(nbytes)
             self.bytes_in_use += int(nbytes)
+            self._account(key, int(nbytes), +1)
 
     def unpin_inflight(self, key):
         with self._mu:
             n = self._inflight.pop(key, None)
             if n is not None:
                 self.bytes_in_use -= n
+                self._account(key, n, -1)
 
     def remove(self, key):
         with self._mu:
             e = self._entries.pop(key, None)
             if e is not None:
                 self.bytes_in_use -= e[0]
+                self._account(key, e[0], -1)
 
     def remove_table(self, table_name: str):
         with self._mu:

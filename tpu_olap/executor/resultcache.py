@@ -432,6 +432,19 @@ class ResultCache:
                 out[c] = out.get(c, 0) + 1
         return out
 
+    def shard_bytes(self, num_shards: int) -> dict:
+        """{chip index: live tier-1 entry bytes} under the interleaved
+        placement (chip of segment sid = sid mod D) — the cache-pin
+        byte attribution the HbmLedger folds into its per-(chip,
+        owner-class) breakdown (ISSUE 17)."""
+        d = max(1, int(num_shards))
+        out: dict = {}
+        with self._lock:
+            for k, e in self._seg.items():
+                c = int(k[2]) % d
+                out[c] = out.get(c, 0) + int(e.nbytes)
+        return out
+
     def count_bypass(self, tier: str = "segment"):
         self._count(tier, "bypass")
 

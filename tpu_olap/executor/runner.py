@@ -25,6 +25,7 @@ from tpu_olap.obs.metrics import MetricsRegistry
 from tpu_olap.obs.profile import annotate_dispatch
 from tpu_olap.obs.slo import SloTracker
 from tpu_olap.obs.trace import (Tracer, current_query_id,
+                                current_traceparent,
                                 in_nested_execution, short_str,
                                 span as _span)
 from tpu_olap.obs.workload import (WorkloadProfiler, fingerprint_ir,
@@ -211,7 +212,9 @@ class QueryRunner:
         # transitions, admission sheds, cache clears, ingest — the ring
         # behind GET /debug/events, with an optional JSONL file sink
         self.events = EventLog(limit=self.config.event_log_limit,
-                               path=self.config.event_log_path)
+                               path=self.config.event_log_path,
+                               max_bytes=self.config.event_log_max_bytes,
+                               rotate_keep=self.config.event_log_rotate_keep)
         # latency SLO accounting (obs.slo): every record() classifies
         # good/bad against slo_latency_ms and updates the burn-rate gauge
         self.slo = SloTracker(self.config.slo_latency_ms,
@@ -330,6 +333,47 @@ class QueryRunner:
                                      admission=self.admission,
                                      inject=self._inject,
                                      events=self.events)
+        # telemetry plane (obs.timeseries + obs.sentinel; ISSUE 17):
+        # the sampler snapshots every metric series into bounded rings
+        # (sys.metrics_history / GET /debug/timeseries); the sentinel
+        # keeps per-template/per-stage drift baselines fed by record()
+        # and runs resource checks on the same periodic tick. Both are
+        # observers only — neither executes SQL nor emits query
+        # records, so the ISSUE 11 no-self-attribution contract holds.
+        from tpu_olap.obs.sentinel import RegressionSentinel
+        from tpu_olap.obs.timeseries import TimeseriesSampler
+        self.telemetry = TimeseriesSampler(
+            m, retention=self.config.telemetry_retention)
+        self.sentinel = RegressionSentinel(self.config, metrics=m,
+                                           events=self.events)
+        ledger = self._hbm_ledger
+        ledger.register_external(
+            "cache_pins", lambda d: self.result_cache.shard_bytes(d))
+        self.sentinel.add_probe("hbm", lambda: {
+            "bytes_in_use": ledger.bytes_in_use,
+            "budget": ledger.budget, "evictions": ledger.evictions})
+        self.sentinel.add_probe(
+            "breaker", lambda: {"state": self.breaker.state})
+        shed_counter = m.counter("queries_shed_total",
+                                 "Queries shed by admission control.",
+                                 ("reason",))
+        self.sentinel.add_probe("admission", lambda: {
+            "shed_total": sum(s.value for s in
+                              list(shed_counter.series.values()))})
+        self._telemetry_handle = None
+        if self.config.telemetry_enabled and \
+                (self.config.telemetry_interval_s or 0) > 0:
+            self._telemetry_handle = self.stages.register_periodic(
+                "telemetry",
+                lambda: self.config.telemetry_interval_s,
+                self._telemetry_tick)
+
+    def _telemetry_tick(self):
+        """One telemetry-graph beat: sample the registry into the
+        history rings, then run the sentinel's resource checks and
+        stale-alert clearing."""
+        self.telemetry.sample_once()
+        self.sentinel.check()
 
     def _inject(self, stage: str):
         """Generalized fault-injection hook (resilience.faults): fires
@@ -550,6 +594,14 @@ class QueryRunner:
         m.setdefault("query_id",
                      current_query_id() or self.tracer.new_query_id())
         m.setdefault("ts_ms", int(time.time() * 1000))
+        # W3C trace context (ISSUE 17): a validated incoming
+        # traceparent (Engine._sql_traced / sql_batch_ids / append)
+        # propagates by contextvar and stamps every record the request
+        # produced, so the future fleet router joins one distributed
+        # trace across replicas
+        tp = current_traceparent()
+        if tp is not None:
+            m.setdefault("traceparent", tp)
         if fp is not None:
             m.setdefault("template_id", fp.template_id)
         for k, v in CORE_METRIC_DEFAULTS:
@@ -658,6 +710,11 @@ class QueryRunner:
                 **({"cache_tier": m["cache_tier"]}
                    if m.get("cache_tier") else {}),
                 **({"failed": True} if failed else {}))
+        # regression sentinel (obs.sentinel): served responses only —
+        # introspection returned above, nested legs returned above, and
+        # the sentinel itself skips failed/deadline records, so the
+        # baselines see exactly the user-visible latency stream
+        self.sentinel.observe(m)
         self.history.append(m)
         return m
 
@@ -705,13 +762,62 @@ class QueryRunner:
         self._m_cache_entries.set(len(self._plan_cache), cache="plan")
         self._m_cache_entries.set(len(self._arg_cache), cache="arg")
         self.result_cache._refresh_gauges()
+        self._refresh_hbm_chip_gauges()
+
+    def _refresh_hbm_chip_gauges(self):
+        """Per-(chip, owner-class) HBM gauges (ISSUE 17): exact ledger
+        attribution plus high-watermark and headroom-vs-budget — the
+        /metrics face of sys.devices' per-chip columns."""
+        m = self.metrics
+        g_bytes = m.gauge(
+            "hbm_chip_bytes",
+            "HBM-resident bytes per chip and owner class (exact "
+            "HbmLedger attribution; cache_pins via the ResultCache "
+            "reporter).", ("chip", "owner"))
+        g_hwm = m.gauge(
+            "hbm_chip_high_watermark_bytes",
+            "Ledger-managed per-chip HBM high-watermark.", ("chip",))
+        g_head = m.gauge(
+            "hbm_chip_headroom_bytes",
+            "Per-chip share of hbm_budget_bytes minus ledger-managed "
+            "resident bytes (absent without a budget).", ("chip",))
+        ledger = self._hbm_ledger
+        snap = ledger.breakdown()
+        hwm = ledger.watermarks()
+        D = ledger.num_chips
+        per_chip_ledger = [0] * D
+        seen = set()
+        for (c, owner), b in snap.items():
+            if 0 <= c < D and owner != "cache_pins":
+                per_chip_ledger[c] += b
+            g_bytes.set(b, chip=c, owner=owner)
+            seen.add((str(c), owner))
+        for key in list(g_bytes.series):
+            if tuple(key) not in seen:  # released class: zero, not stale
+                g_bytes.set(0.0, chip=key[0], owner=key[1])
+        budget = ledger.budget
+        for c in range(D):
+            g_hwm.set(hwm["per_chip"][c] if c < len(hwm["per_chip"])
+                      else 0, chip=c)
+            if budget:
+                g_head.set(budget / D - per_chip_ledger[c], chip=c)
+        m.gauge("hbm_high_watermark_bytes",
+                "Ledger-managed total HBM high-watermark.") \
+            .set(hwm["total"])
 
     def device_snapshot(self) -> list:
         """Per-chip serving state behind sys.devices and
         GET /debug/devices: logical segments owned under the
         interleaved placement (segment i → chip i mod D), resident
         device bytes, multi-chip dispatch participation, and tier-1
-        cache-shard entries (chip of an entry = its segment's owner)."""
+        cache-shard entries (chip of an entry = its segment's owner).
+
+        The per-chip HBM columns (ISSUE 17) come from the ledger's
+        exact per-(chip, owner-class) attribution — table columns,
+        cube tables, in-flight pins sum to the ledger's bytes_in_use;
+        cache_pin_bytes rides alongside from the ResultCache reporter —
+        plus ledger-managed high-watermark and headroom against the
+        per-chip share of the HBM budget."""
         mesh = self.mesh
         if self.config.platform == "cpu":
             devs = [None]
@@ -736,10 +842,22 @@ class QueryRunner:
                 seg[0] += n_seg
                 res_bytes[0] += b
         cache_by_chip = self.result_cache.shard_entries(D)
+        ledger = self._hbm_ledger
+        hbm = ledger.breakdown()
+        hwm = ledger.watermarks()
+        budget = ledger.budget
+        chip_budget = (budget / D) if budget else None
         with self._totals_lock:
             disp = dict(self._chip_dispatches)
         rows = []
         for c, d in enumerate(devs):
+            col_b = hbm.get((c, "table_columns"), 0)
+            cube_b = hbm.get((c, "cube_tables"), 0)
+            infl_b = hbm.get((c, "inflight"), 0)
+            cache_b = hbm.get((c, "cache_pins"), 0)
+            ledger_b = col_b + cube_b + infl_b
+            chip_hwm = hwm["per_chip"][c] \
+                if c < len(hwm["per_chip"]) else 0
             rows.append({
                 "index": c,
                 "device": str(d) if d is not None else "numpy-host",
@@ -752,6 +870,14 @@ class QueryRunner:
                 "cache_shard_entries": cache_by_chip.get(c, 0),
                 "rebased_cols": rebased_cols,
                 "rebase_rows_uploaded": rebase_rows,
+                "hbm_bytes": int(ledger_b),
+                "table_column_bytes": int(col_b),
+                "cube_table_bytes": int(cube_b),
+                "inflight_bytes": int(infl_b),
+                "cache_pin_bytes": int(cache_b),
+                "hbm_high_watermark_bytes": int(chip_hwm),
+                "hbm_headroom_bytes": (int(chip_budget - ledger_b)
+                                       if chip_budget else None),
             })
         return rows
 
@@ -770,6 +896,10 @@ class QueryRunner:
                 (self._active_shards or 1) > 1:
             from tpu_olap.executor.sharding import make_mesh
             self._mesh = make_mesh(self._active_shards)
+            # the ledger learns the chip count the moment the mesh
+            # exists, so every subsequent add splits per chip exactly
+            # (ISSUE 17 per-chip HBM attribution)
+            self._hbm_ledger.set_num_chips(self._mesh.devices.size)
         return self._mesh
 
     def _dispatch(self, call, metrics: dict, table_name: str):

@@ -406,10 +406,19 @@ class StageScheduler:
         record's `stages` block."""
         pool = self.pools[name]
         if self._inject is not None:
+            # a latency-mode fault (FaultInjector.latency_s) stalls the
+            # query BETWEEN stages; count that stall as this stage's
+            # wait so the regression sentinel attributes the drift to
+            # the stage the slow link sits in front of (ISSUE 17)
+            ti = time.perf_counter()
             self._inject(f"stage-{name}")
+            inject_ms = (time.perf_counter() - ti) * 1000
+        else:
+            inject_ms = 0.0
         if budget_s is None:
             budget_s = getattr(self.config, "query_deadline_s", None)
         with pool.section(budget_s) as waited_ms:
+            waited_ms += inject_ms
             if self._m_wait is not None:
                 self._m_wait.observe(waited_ms, stage=name)
             t0 = time.perf_counter()

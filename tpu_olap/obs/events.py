@@ -60,9 +60,18 @@ class EventLog:
     # this depth instead of growing host memory without limit
     _SINK_QUEUE_MAX = 4096
 
-    def __init__(self, limit: int = 2048, path: str | None = None):
+    def __init__(self, limit: int = 2048, path: str | None = None,
+                 max_bytes: int = 0, rotate_keep: int = 3):
         self.limit = max(1, int(limit))
         self.path = path
+        # size-based sink rotation (ISSUE 17 satellite): past max_bytes
+        # the file rotates to path.1 (shifting .1 -> .2 ..., keeping
+        # `rotate_keep` rotated files) and a sink_rotate event records
+        # the roll. 0 = unbounded (the pre-rotation behavior).
+        self.max_bytes = max(0, int(max_bytes or 0))
+        self.rotate_keep = max(1, int(rotate_keep))
+        self.rotations = 0
+        self._file_bytes = 0
         self._ring: deque = deque(maxlen=self.limit)
         self._lock = threading.Lock()  # ring only
         self._seq = itertools.count(1)
@@ -145,7 +154,16 @@ class EventLog:
         try:
             if self._file is None:
                 self._file = open(self.path, "a", buffering=1)
-            self._file.write(json.dumps(rec, default=str) + "\n")
+                import os
+                try:
+                    self._file_bytes = os.path.getsize(self.path)
+                except OSError:
+                    self._file_bytes = 0
+            line = json.dumps(rec, default=str) + "\n"
+            self._file.write(line)
+            self._file_bytes += len(line)
+            if self.max_bytes and self._file_bytes >= self.max_bytes:
+                self._rotate()
         except Exception:  # noqa: BLE001 — sink failure ≠ query failure
             with self._wcv:
                 self.sink_errors += 1
@@ -156,6 +174,37 @@ class EventLog:
                 except Exception:  # noqa: BLE001
                     pass
                 self._file = None
+
+    def _rotate(self):
+        """Size-based roll, on the writer thread (the only file-I/O
+        site, so no locking): path -> path.1, shifting existing .N up
+        and dropping past rotate_keep. The sink_rotate event lands in
+        the ring AND (via the queue) as the fresh file's first lines."""
+        import os
+        try:
+            self._file.close()
+        except Exception:  # noqa: BLE001
+            pass
+        self._file = None
+        rotated_bytes = self._file_bytes
+        self._file_bytes = 0
+        try:
+            drop = f"{self.path}.{self.rotate_keep}"
+            if os.path.exists(drop):
+                os.unlink(drop)
+            for i in range(self.rotate_keep - 1, 0, -1):
+                src = f"{self.path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{self.path}.{i + 1}")
+            os.replace(self.path, f"{self.path}.1")
+        except OSError:
+            with self._wcv:
+                self.sink_errors += 1
+            return
+        self.rotations += 1
+        self.emit("sink_rotate", path=self.path,
+                  rotated_bytes=rotated_bytes, keep=self.rotate_keep,
+                  rotations=self.rotations)
 
     def flush(self, timeout: float = 5.0) -> bool:
         """Block until queued sink writes drain (tests, shutdown).

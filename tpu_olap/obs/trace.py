@@ -37,6 +37,8 @@ _current_qid: contextvars.ContextVar = contextvars.ContextVar(
     "tpu_olap_current_query_id", default=None)
 _nested_exec: contextvars.ContextVar = contextvars.ContextVar(
     "tpu_olap_nested_exec", default=False)
+_traceparent: contextvars.ContextVar = contextvars.ContextVar(
+    "tpu_olap_traceparent", default=None)
 
 # attribute values are clipped at record time so a span tree is always
 # JSON-small (an exception repr or a full SQL text must not bloat the
@@ -223,6 +225,64 @@ class nested_execution:
 
 def in_nested_execution() -> bool:
     return _nested_exec.get()
+
+
+# ------------------------------------------------- W3C trace context
+
+# traceparent per the W3C Trace Context spec (version 00):
+#   00-<32 hex trace-id>-<16 hex parent-id>-<2 hex flags>
+# The engine is a participant, not an originator: a valid incoming
+# header is stamped on the root span and every query record, so the
+# fleet router (ROADMAP item 2) can join one distributed trace across
+# replicas. Invalid headers are dropped silently per the spec.
+import re as _re
+
+_TRACEPARENT_RE = _re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+
+def parse_traceparent(value) -> dict | None:
+    """{'traceparent', 'trace_id', 'parent_id', 'flags'} for a valid
+    W3C traceparent header, else None. All-zero trace/parent ids are
+    invalid per the spec; future versions (>00) are accepted as long
+    as they carry the version-00 prefix fields."""
+    if not isinstance(value, str):
+        return None
+    m = _TRACEPARENT_RE.match(value.strip().lower())
+    if m is None:
+        return None
+    version, trace_id, parent_id, flags = m.groups()
+    if version == "ff" or trace_id == "0" * 32 or parent_id == "0" * 16:
+        return None
+    return {"traceparent": m.group(0), "trace_id": trace_id,
+            "parent_id": parent_id, "flags": flags}
+
+
+class use_traceparent:
+    """Propagate an incoming (already-validated) traceparent header for
+    a scope, so QueryRunner.record() can stamp it onto every query
+    record the scope produces. `None` is a no-op scope."""
+
+    __slots__ = ("value", "_token")
+
+    def __init__(self, value: str | None):
+        self.value = value
+        self._token = None
+
+    def __enter__(self):
+        if self.value is not None:
+            self._token = _traceparent.set(self.value)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._token is not None:
+            _traceparent.reset(self._token)
+            self._token = None
+        return False
+
+
+def current_traceparent() -> str | None:
+    return _traceparent.get()
 
 
 class detached_trace:

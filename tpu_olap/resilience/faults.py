@@ -79,15 +79,22 @@ class FaultInjector:
     """Deterministic seeded chaos injector for tests and bench runs:
     raises RuntimeError at each opted-in site with probability `rate`
     (or on an explicit schedule via `fail_calls`). `stages=None` opts
-    into every site."""
+    into every site.
+
+    `latency_s` > 0 turns a hit into a SLEEP instead of a raise — the
+    slow-device/slow-link chaos mode (ISSUE 17): the query still
+    succeeds, just late, which is exactly the drift the regression
+    sentinel (obs.sentinel) must catch and attribute to the injected
+    stage. A hit with `latency_s` at 0 keeps the classic raise."""
 
     def __init__(self, seed: int = 0, rate: float = 0.0, stages=None,
-                 fail_calls=()):
+                 fail_calls=(), latency_s: float = 0.0):
         import random
         self.rng = random.Random(seed)
         self.rate = float(rate)
         self.stages = stages
         self.fail_calls = set(fail_calls)
+        self.latency_s = float(latency_s)
         self.calls = 0
         self.faults = 0
         self.by_stage: dict[str, int] = {}
@@ -99,6 +106,10 @@ class FaultInjector:
         if hit:
             self.faults += 1
             self.by_stage[stage] = self.by_stage.get(stage, 0) + 1
+            if self.latency_s > 0:
+                import time
+                time.sleep(self.latency_s)
+                return
             raise RuntimeError(
                 f"injected fault #{self.faults} at {stage} "
                 f"(call {self.calls}, attempt {attempt})")
